@@ -1,0 +1,27 @@
+"""simple_pbft_tpu — a TPU-native PBFT consensus framework.
+
+A from-scratch rebuild of the capabilities of the reference `simple_pbft`
+(an educational pure-Go PBFT: three-phase pre-prepare/prepare/commit
+consensus for an f=1 committee; see /root/reference, surveyed in SURVEY.md),
+redesigned TPU-first:
+
+- **Consensus plane** (pure Python, event-driven): per-sequence-number PBFT
+  state machines (replacing the reference's single scalar ``CurrentState``,
+  node.go:21), message pools keyed by (view, seq) (replacing the
+  per-NodeID/per-ClientID pools in pool/*.go), an asyncio replica runtime
+  with event-driven wakeups (replacing the 1 s polling tick, node.go:44,513),
+  a client library with f+1 matching replies, checkpointing with h/H
+  watermarks, and a full view-change protocol (the reference's view.go is
+  dead code).
+
+- **Crypto plane** (JAX/XLA/Pallas, the TPU-native part): every consensus
+  message is Ed25519-signed (the reference has *no* signatures —
+  see SURVEY.md §2.9), and signature verification — the hot path of any
+  production PBFT — is batched and executed on TPU: pools drain pending
+  (message, signature, pubkey) tuples into one vmapped Ed25519 verification
+  pass, with GF(2^255-19) field arithmetic in limb-decomposed int32
+  vector ops / Pallas kernels, returning a validity bitmap so
+  quorum-certificate formation is one TPU call per round.
+"""
+
+__version__ = "0.1.0"

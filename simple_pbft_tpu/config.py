@@ -1,0 +1,86 @@
+"""Committee configuration.
+
+Replaces the reference's hard-coded everything: the 4-entry NodeTable
+(node.go:60-65), f=1 duplicated in two files (node.go:45, pbft_impl.go:37),
+the fixed primary "MainNode" (node.go:68), and the magic view id
+(node.go:55). Here the committee is data: an ordered replica list, f derived
+from it, per-replica Ed25519 public keys, rotating primary, and the
+batching / checkpoint / watermark knobs the reference lacks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from .crypto import ed25519_cpu
+
+
+@dataclass(frozen=True)
+class CommitteeConfig:
+    """Static description of a PBFT committee."""
+
+    replica_ids: Tuple[str, ...]
+    pubkeys: Dict[str, bytes]  # replica/client id -> 32-byte Ed25519 pubkey
+    checkpoint_interval: int = 64
+    watermark_window: int = 256  # H = h + watermark_window
+    max_batch: int = 256  # max client requests per block
+    view_timeout: float = 2.0  # seconds before a replica suspects the primary
+    verify_signatures: bool = True
+
+    @property
+    def n(self) -> int:
+        return len(self.replica_ids)
+
+    @property
+    def f(self) -> int:
+        """Max Byzantine replicas: n >= 3f + 1."""
+        return (self.n - 1) // 3
+
+    @property
+    def quorum(self) -> int:
+        """2f+1 — prepare/commit certificate size (distinct senders,
+        counting the replica's own vote; Castro-Liskov quorums, vs. the
+        reference's 2f-others formulation at pbft_impl.go:212,227)."""
+        return 2 * self.f + 1
+
+    @property
+    def weak_quorum(self) -> int:
+        """f+1 — at least one honest replica (client reply matching)."""
+        return self.f + 1
+
+    def primary(self, view: int) -> str:
+        """Round-robin primary rotation (the reference sketched this in its
+        dead view.go:13-31 but never wired it)."""
+        return self.replica_ids[view % self.n]
+
+    def pubkey(self, node_id: str) -> Optional[bytes]:
+        return self.pubkeys.get(node_id)
+
+
+@dataclass
+class KeyPair:
+    seed: bytes
+    pub: bytes
+
+    @staticmethod
+    def generate(seed: bytes) -> "KeyPair":
+        return KeyPair(seed=seed, pub=ed25519_cpu.public_key(seed))
+
+
+def make_test_committee(
+    n: int = 4, clients: int = 1, **overrides
+) -> Tuple[CommitteeConfig, Dict[str, KeyPair]]:
+    """Deterministic committee for tests/benchmarks: replicas r0..r{n-1},
+    clients c0..c{clients-1}, keys derived from ids."""
+    ids = tuple(f"r{i}" for i in range(n))
+    keys: Dict[str, KeyPair] = {}
+    for name in list(ids) + [f"c{i}" for i in range(clients)]:
+        seed = (name.encode() * 32)[:32]
+        keys[name] = KeyPair.generate(seed)
+    cfg = CommitteeConfig(
+        replica_ids=ids,
+        pubkeys={k: v.pub for k, v in keys.items()},
+        **overrides,
+    )
+    return cfg, keys

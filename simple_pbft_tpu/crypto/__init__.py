@@ -1,0 +1,18 @@
+"""Crypto plane: Ed25519 signing/verification with pluggable backends.
+
+The reference has *no* signature cryptography (grep over /root/reference:
+only SHA-256 in utils/utils.go:13-17); its author's gap list
+(需要改进的地方.md:17) calls for per-node keys and signed consensus messages.
+This package supplies that, TPU-first:
+
+- ``ed25519_cpu``: pure-Python RFC 8032 implementation — signing, and the
+  known-answer verification oracle.
+- ``field_jax`` / ``ed25519_jax``: batched verification in JAX for TPU —
+  limb-decomposed GF(2^255-19) arithmetic, vmapped double-scalar
+  multiplication, verdict bitmaps.
+- ``verifier``: the pluggable ``Verifier`` seam the consensus plane drains
+  batches into (the seam sits where the reference's prepared()/committed()
+  quorum predicates live, pbft_impl.go:207-232).
+"""
+
+from .verifier import BatchItem, CpuVerifier, Verifier  # noqa: F401

@@ -1,0 +1,61 @@
+"""The pluggable ``Verifier`` seam between consensus and crypto backends.
+
+This is the north-star interface from BASELINE.json: the consensus plane
+drains every pending (message-bytes, signature, pubkey) tuple from its pools
+into ``verify_batch`` and gets back a validity bitmap, so quorum-certificate
+formation costs one backend call per round. The seam sits exactly where the
+reference's ``prepared()``/``committed()`` quorum predicates would have
+verified votes inline (pbft_impl.go:207-232) had it had signatures.
+
+Backends:
+- ``CpuVerifier`` — pure-Python RFC 8032 (reference-equivalent behavior,
+  known-answer oracle).
+- ``TpuVerifier`` (crypto/tpu_verifier.py) — batches onto TPU via the JAX
+  Ed25519 pipeline, padding to bucketed batch shapes to avoid recompiles.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Protocol, Sequence
+
+from . import ed25519_cpu
+
+
+@dataclass(frozen=True)
+class BatchItem:
+    """One pending signature check: (pubkey, message bytes, signature)."""
+
+    pubkey: bytes  # 32-byte compressed Ed25519 public key
+    msg: bytes  # the signed payload (canonical message encoding)
+    sig: bytes  # 64-byte signature (R || S)
+
+
+class Verifier(Protocol):
+    """Backend interface: batch in, bitmap out. Must be order-preserving."""
+
+    def verify_batch(self, items: Sequence[BatchItem]) -> List[bool]:
+        ...
+
+
+class CpuVerifier:
+    """Reference-equivalent CPU backend (pure-Python RFC 8032)."""
+
+    name = "cpu"
+
+    def verify_batch(self, items: Sequence[BatchItem]) -> List[bool]:
+        return ed25519_cpu.batch_verify_cpu(
+            [it.pubkey for it in items],
+            [it.msg for it in items],
+            [it.sig for it in items],
+        )
+
+
+class InsecureVerifier:
+    """Accept-everything backend — parity mode with the unsigned reference
+    (useful for isolating consensus-plane behavior/benchmarks from crypto)."""
+
+    name = "insecure"
+
+    def verify_batch(self, items: Sequence[BatchItem]) -> List[bool]:
+        return [True] * len(items)

@@ -1,0 +1,19 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Benchmarks (bench.py) run on the real TPU chip; tests exercise the same
+jitted code paths on CPU, with 8 virtual devices so the shard_map /
+multi-chip sharding paths are genuinely executed (see SURVEY.md §7 and the
+driver's dryrun_multichip contract).
+
+Must run before jax is imported anywhere — hence env vars set at module
+import time in conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

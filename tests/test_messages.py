@@ -1,0 +1,104 @@
+"""Message schema: canonical serialization, digests, signing payloads."""
+
+from simple_pbft_tpu import messages as m
+from simple_pbft_tpu.crypto import ed25519_cpu as ed
+
+
+def test_roundtrip_all_kinds():
+    samples = [
+        m.Request(sender="c1", client_id="c1", timestamp=7, operation="put x 1"),
+        m.Reply(sender="r0", view=1, seq=2, client_id="c1", timestamp=7, result="ok"),
+        m.PrePrepare(sender="r0", view=0, seq=1, digest="ab", block=[{"op": 1}]),
+        m.Prepare(sender="r1", view=0, seq=1, digest="ab"),
+        m.Commit(sender="r2", view=0, seq=1, digest="ab"),
+        m.Checkpoint(sender="r1", seq=100, state_digest="cd"),
+        m.ViewChange(sender="r3", new_view=2, stable_seq=100),
+        m.NewView(sender="r2", new_view=2),
+    ]
+    for msg in samples:
+        wire = msg.to_wire()
+        back = m.Message.from_wire(wire)
+        assert back == msg
+        assert type(back) is type(msg)
+
+
+def test_canonical_encoding_deterministic():
+    a = m.Prepare(sender="r1", view=3, seq=9, digest="dd")
+    b = m.Prepare(digest="dd", seq=9, view=3, sender="r1")
+    assert a.to_wire() == b.to_wire()
+    assert a.payload_digest() == b.payload_digest()
+
+
+def test_signing_payload_excludes_sig():
+    msg = m.Prepare(sender="r1", view=1, seq=1, digest="d")
+    unsigned_payload = msg.signing_payload()
+    msg.sig = "aa" * 64
+    assert msg.signing_payload() == unsigned_payload
+    assert msg.payload_digest() == m.Message.from_wire(msg.to_wire()).payload_digest()
+
+
+def test_sign_and_verify_message():
+    seed = b"\x05" * 32
+    pub = ed.public_key(seed)
+    msg = m.Commit(sender="r2", view=1, seq=4, digest="beef")
+    msg.sig = ed.sign(seed, msg.signing_payload()).hex()
+    assert ed.verify(pub, msg.signing_payload(), bytes.fromhex(msg.sig))
+    # Mutating any field invalidates
+    msg.seq = 5
+    assert not ed.verify(pub, msg.signing_payload(), bytes.fromhex(msg.sig))
+
+
+def test_block_digest_matches_content():
+    block = [{"client_id": "c", "timestamp": 1, "operation": "x"}]
+    d1 = m.PrePrepare.block_digest(block)
+    d2 = m.PrePrepare.block_digest(list(block))
+    assert d1 == d2
+    assert d1 != m.PrePrepare.block_digest([])
+
+
+def test_from_wire_malformed_always_valueerror():
+    import pytest
+
+    bad = [
+        b"not json",
+        b"123",
+        b"[1,2]",
+        b'{"kind":"nope"}',
+        b'{"no_kind":1}',
+        b'{"kind":"prepare","sender":{"x":1}}',
+        b'{"kind":"prepare","view":"high"}',
+        b'{"kind":"prepare","view":true}',
+        b'{"kind":"preprepare","block":"notalist"}',
+        b"\xff\xfe",
+    ]
+    for raw in bad:
+        with pytest.raises(ValueError):
+            m.Message.from_wire(raw)
+
+
+def test_from_wire_hostile_nesting_and_size():
+    import pytest
+
+    deep = b"[" * 200000 + b"]" * 200000
+    with pytest.raises(ValueError):
+        m.Message.from_wire(b'{"kind":"preprepare","block":' + deep + b"}")
+    nested = {"kind": "preprepare", "block": [{"a": 1}]}
+    cur = nested["block"][0]
+    for _ in range(100):
+        cur["a"] = [{"a": 1}]
+        cur = cur["a"][0]
+    import json
+
+    with pytest.raises(ValueError):
+        m.Message.from_dict(nested)
+    with pytest.raises(ValueError):
+        m.Message.from_wire(b" " * (m.Message.MAX_WIRE_BYTES + 1))
+
+
+def test_list_fields_require_dict_elements():
+    import pytest
+
+    with pytest.raises(ValueError):
+        m.Message.from_wire(
+            b'{"kind":"preprepare","view":0,"seq":1,"digest":"d","block":[1,"x"]}'
+        )

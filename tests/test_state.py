@@ -1,0 +1,166 @@
+"""Unit tests for the pure PBFT instance state machine."""
+
+from simple_pbft_tpu.consensus.state import (
+    ExecuteBlock,
+    Instance,
+    SendCommit,
+    SendPrepare,
+    Stage,
+)
+from simple_pbft_tpu.messages import Commit, PrePrepare, Prepare
+
+
+QUORUM = 3  # n=4, f=1 -> 2f+1 = 3
+
+
+def make_preprepare(view=0, seq=1, sender="r0"):
+    block = [{"client_id": "c0", "timestamp": 1, "operation": "x"}]
+    return PrePrepare(
+        sender=sender,
+        view=view,
+        seq=seq,
+        digest=PrePrepare.block_digest(block),
+        block=block,
+    )
+
+
+def test_happy_path_full_round():
+    pp = make_preprepare()
+    inst = Instance(view=0, seq=1, quorum=QUORUM, primary="r0")
+
+    acts = inst.on_pre_prepare(pp)
+    assert [type(a) for a in acts] == [SendPrepare]
+    assert inst.stage == Stage.PRE_PREPARED
+
+    # 3 prepare votes (incl. own) -> prepared, send commit
+    acts = []
+    for r in ["r0", "r1", "r2"]:
+        acts += inst.on_prepare(
+            Prepare(sender=r, view=0, seq=1, digest=pp.digest)
+        )
+    assert [type(a) for a in acts] == [SendCommit]
+    assert inst.stage == Stage.PREPARED
+
+    acts = []
+    for r in ["r1", "r2", "r3"]:
+        acts += inst.on_commit(
+            Commit(sender=r, view=0, seq=1, digest=pp.digest)
+        )
+    assert [type(a) for a in acts] == [ExecuteBlock]
+    assert inst.stage == Stage.COMMITTED
+    assert acts[0].block == pp.block
+
+
+def test_votes_before_preprepare_buffered_then_fire():
+    """Prepare votes arriving before the pre-prepare (network reordering —
+    the hazard the reference's pools absorb, SURVEY.md §3.3) must count
+    once the proposal lands."""
+    pp = make_preprepare()
+    inst = Instance(view=0, seq=1, quorum=QUORUM, primary="r0")
+    for r in ["r1", "r2", "r3"]:
+        assert inst.on_prepare(
+            Prepare(sender=r, view=0, seq=1, digest=pp.digest)
+        ) == []
+    acts = inst.on_pre_prepare(pp)
+    # pre-prepare triggers own prepare AND the already-satisfied quorum
+    assert [type(a) for a in acts] == [SendPrepare, SendCommit]
+    assert inst.stage == Stage.PREPARED
+
+
+def test_duplicate_votes_dont_count():
+    pp = make_preprepare()
+    inst = Instance(view=0, seq=1, quorum=QUORUM, primary="r0")
+    inst.on_pre_prepare(pp)
+    for _ in range(5):
+        inst.on_prepare(Prepare(sender="r1", view=0, seq=1, digest=pp.digest))
+    assert not inst.prepared()
+
+
+def test_wrong_digest_votes_dont_count():
+    pp = make_preprepare()
+    inst = Instance(view=0, seq=1, quorum=QUORUM, primary="r0")
+    inst.on_pre_prepare(pp)
+    for r in ["r1", "r2", "r3"]:
+        inst.on_prepare(Prepare(sender=r, view=0, seq=1, digest="evil"))
+    assert not inst.prepared()
+
+
+def test_wrong_view_or_seq_ignored():
+    pp = make_preprepare()
+    inst = Instance(view=0, seq=1, quorum=QUORUM, primary="r0")
+    inst.on_pre_prepare(pp)
+    assert inst.on_prepare(Prepare(sender="r1", view=1, seq=1, digest=pp.digest)) == []
+    assert inst.on_prepare(Prepare(sender="r1", view=0, seq=2, digest=pp.digest)) == []
+    assert inst.prepares == {}
+
+
+def test_preprepare_digest_mismatch_rejected():
+    pp = make_preprepare()
+    pp.digest = "not-the-block-digest"
+    inst = Instance(view=0, seq=1, quorum=QUORUM, primary="r0")
+    assert inst.on_pre_prepare(pp) == []
+    assert inst.stage == Stage.IDLE
+
+
+def test_conflicting_preprepare_first_wins():
+    pp1 = make_preprepare()
+    block2 = [{"client_id": "c0", "timestamp": 2, "operation": "y"}]
+    pp2 = PrePrepare(
+        sender="r0", view=0, seq=1,
+        digest=PrePrepare.block_digest(block2), block=block2,
+    )
+    inst = Instance(view=0, seq=1, quorum=QUORUM, primary="r0")
+    inst.on_pre_prepare(pp1)
+    assert inst.on_pre_prepare(pp2) == []
+    assert inst.digest == pp1.digest
+
+
+def test_execute_fires_exactly_once():
+    pp = make_preprepare()
+    inst = Instance(view=0, seq=1, quorum=QUORUM, primary="r0")
+    inst.on_pre_prepare(pp)
+    for r in ["r0", "r1", "r2"]:
+        inst.on_prepare(Prepare(sender=r, view=0, seq=1, digest=pp.digest))
+    execs = []
+    for r in ["r0", "r1", "r2", "r3"]:
+        for a in inst.on_commit(Commit(sender=r, view=0, seq=1, digest=pp.digest)):
+            if isinstance(a, ExecuteBlock):
+                execs.append(a)
+    assert len(execs) == 1
+
+
+def test_prepared_proof_certificate():
+    pp = make_preprepare()
+    inst = Instance(view=0, seq=1, quorum=QUORUM, primary="r0")
+    assert inst.prepared_proof() is None
+    inst.on_pre_prepare(pp)
+    for r in ["r0", "r1", "r2"]:
+        inst.on_prepare(Prepare(sender=r, view=0, seq=1, digest=pp.digest))
+    proof = inst.prepared_proof()
+    assert proof is not None
+    assert proof["pre_prepare"]["digest"] == pp.digest
+    assert len(proof["prepares"]) == QUORUM
+
+
+def test_larger_committee_quorum():
+    # n=7, f=2, quorum=5
+    pp = make_preprepare()
+    inst = Instance(view=0, seq=1, quorum=5, primary="r0")
+    inst.on_pre_prepare(pp)
+    for r in ["r0", "r1", "r2", "r3"]:
+        inst.on_prepare(Prepare(sender=r, view=0, seq=1, digest=pp.digest))
+    assert not inst.prepared()
+    inst.on_prepare(Prepare(sender="r4", view=0, seq=1, digest=pp.digest))
+    assert inst.prepared()
+
+
+def test_preprepare_from_non_primary_rejected():
+    """A Byzantine backup must not steal a slot with its own pre-prepare."""
+    pp = make_preprepare(sender="r3")
+    inst = Instance(view=0, seq=1, quorum=QUORUM, primary="r0")
+    assert inst.on_pre_prepare(pp) == []
+    assert inst.stage == Stage.IDLE
+    # the real primary's proposal still lands
+    assert [type(a) for a in inst.on_pre_prepare(make_preprepare(sender="r0"))] == [
+        SendPrepare
+    ]
