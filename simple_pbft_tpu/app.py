@@ -1,0 +1,94 @@
+"""Pluggable application (execution) layer.
+
+The reference never executes anything: commit sets ``result = "Executed"``
+(a literal string, pbft_impl.go:158) and drops the operation. Here
+execution is a real seam: committed blocks are applied in sequence order to
+an ``Application``, whose state digest feeds checkpoint messages, and whose
+snapshot/restore pair supports state transfer to lagging replicas.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, Protocol
+
+
+class Application(Protocol):
+    def apply(self, op: str) -> str:
+        """Execute one operation, return its result string."""
+        ...
+
+    def state_digest(self) -> str:
+        """Digest of current state (checkpoint identity). Must equal
+        sha256(snapshot()) so snapshots are verifiable against checkpoint
+        certificates."""
+        ...
+
+    def snapshot(self) -> str:
+        """Serialize full state (state-transfer payload)."""
+        ...
+
+    def restore(self, snap: str) -> None:
+        """Replace state with a snapshot."""
+        ...
+
+
+def snapshot_digest(snap: str) -> str:
+    return hashlib.sha256(snap.encode()).hexdigest()
+
+
+class EchoApp:
+    """Reference-parity app: every operation 'executes' to a fixed string
+    (mirrors pbft_impl.go:158)."""
+
+    def apply(self, op: str) -> str:
+        return "Executed"
+
+    def snapshot(self) -> str:
+        return ""
+
+    def restore(self, snap: str) -> None:
+        pass
+
+    def state_digest(self) -> str:
+        return snapshot_digest("")
+
+
+class KVStore:
+    """Tiny ordered key-value store: ``put k v`` / ``get k`` / ``noop``.
+
+    Deterministic across replicas (a requirement the reference never faced,
+    having no execution). The state digest is the hash of the canonical
+    snapshot, so a lagging replica can verify a transferred snapshot
+    against a 2f+1 checkpoint certificate.
+    """
+
+    def __init__(self) -> None:
+        self.data: Dict[str, str] = {}
+
+    def apply(self, op: str) -> str:
+        parts = op.split(" ")
+        if parts[0] == "put" and len(parts) >= 3:
+            key, value = parts[1], " ".join(parts[2:])
+            self.data[key] = value
+            return "ok"
+        if parts[0] == "get" and len(parts) == 2:
+            return self.data.get(parts[1], "")
+        if parts[0] == "noop":
+            return "ok"
+        return "err:bad-op"
+
+    def snapshot(self) -> str:
+        return json.dumps(self.data, sort_keys=True, separators=(",", ":"))
+
+    def restore(self, snap: str) -> None:
+        data = json.loads(snap)
+        if not isinstance(data, dict) or not all(
+            isinstance(k, str) and isinstance(v, str) for k, v in data.items()
+        ):
+            raise ValueError("bad snapshot")
+        self.data = data
+
+    def state_digest(self) -> str:
+        return snapshot_digest(self.snapshot())

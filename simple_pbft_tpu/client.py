@@ -1,0 +1,129 @@
+"""Client library: submit requests, collect f+1 matching replies.
+
+The reference client (client.go:12-34) fire-and-forgets one request at the
+primary and exits — no reply collection, no retry, no f+1 matching; all
+called out in its author's gap list (需要改进的地方.md:3-9). This client:
+
+- signs requests (client identities have keys like replicas);
+- sends to the current primary, rebroadcasts to ALL replicas on timeout
+  (the PBFT liveness path that eventually triggers a view change);
+- waits for f+1 replies with matching (timestamp, result) before
+  accepting — f+1 guarantees at least one honest replica's word.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from collections import defaultdict
+from typing import Dict, Optional, Tuple
+
+from .config import CommitteeConfig
+from .crypto.signer import Signer
+from .crypto.verifier import BatchItem, Verifier, best_cpu_verifier
+from .messages import Message, Reply, Request
+from .transport.base import Transport
+
+
+class Client:
+    def __init__(
+        self,
+        client_id: str,
+        cfg: CommitteeConfig,
+        seed: bytes,
+        transport: Transport,
+        verifier: Optional[Verifier] = None,
+        request_timeout: float = 1.0,
+    ) -> None:
+        self.id = client_id
+        self.cfg = cfg
+        self.signer = Signer(client_id, seed)
+        self.transport = transport
+        self.verifier = verifier if verifier is not None else best_cpu_verifier()
+        self.request_timeout = request_timeout
+        self._ts = itertools.count(1)
+        self._waiters: Dict[int, asyncio.Future] = {}
+        self._replies: Dict[int, Dict[str, Tuple[str, int]]] = defaultdict(dict)
+        self._task: Optional[asyncio.Task] = None
+        self.view_hint = 0  # latest view seen in replies
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._recv_loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _recv_loop(self) -> None:
+        while True:
+            raw = await self.transport.recv()
+            try:
+                msg = Message.from_wire(raw)
+            except ValueError:
+                continue
+            if not isinstance(msg, Reply) or msg.client_id != self.id:
+                continue
+            if msg.sender not in self.cfg.replica_ids:
+                continue  # only replicas may answer; f+1 matching assumes it
+            if self.cfg.verify_signatures:
+                pub = self.cfg.pubkey(msg.sender)
+                if pub is None or not msg.sig:
+                    continue
+                try:
+                    sig = bytes.fromhex(msg.sig)
+                except ValueError:
+                    continue
+                ok = self.verifier.verify_batch(
+                    [BatchItem(pubkey=pub, msg=msg.signing_payload(), sig=sig)]
+                )
+                if not ok[0]:
+                    continue
+            self._on_reply(msg)
+
+    def _on_reply(self, msg: Reply) -> None:
+        ts = msg.timestamp
+        fut = self._waiters.get(ts)
+        if fut is None or fut.done():
+            return
+        self.view_hint = max(self.view_hint, msg.view)
+        self._replies[ts][msg.sender] = (msg.result, msg.view)
+        counts: Dict[Tuple[str, int], int] = defaultdict(int)
+        for val in self._replies[ts].values():
+            counts[val] += 1
+        for (result, _view), cnt in counts.items():
+            if cnt >= self.cfg.weak_quorum:
+                fut.set_result(result)
+                return
+
+    async def submit(self, operation: str, retries: int = 3) -> str:
+        """Submit one operation; return the f+1-matched result."""
+        ts = next(self._ts)
+        req = Request(client_id=self.id, timestamp=ts, operation=operation)
+        self.signer.sign_msg(req)
+        raw = req.to_wire()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._waiters[ts] = fut
+        try:
+            # first attempt: primary only; afterwards: broadcast (classic
+            # PBFT retransmission — backups forward to the primary and arm
+            # view-change timers)
+            await self.transport.send(
+                self.cfg.primary(self.view_hint), raw
+            )
+            for attempt in range(retries + 1):
+                try:
+                    return await asyncio.wait_for(
+                        asyncio.shield(fut), self.request_timeout
+                    )
+                except asyncio.TimeoutError:
+                    if attempt == retries:
+                        raise
+                    await self.transport.broadcast(raw, self.cfg.replica_ids)
+            raise asyncio.TimeoutError  # pragma: no cover
+        finally:
+            self._waiters.pop(ts, None)
+            self._replies.pop(ts, None)

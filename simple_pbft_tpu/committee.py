@@ -1,0 +1,77 @@
+"""One-call harness: an N-replica committee + clients on a local network.
+
+The reference's only "deployment" is run.bat launching 4 Windows processes;
+this harness is its in-process equivalent and the substrate for every test
+and benchmark config in BASELINE.md (4 → 256 replicas).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .app import Application, KVStore
+from .client import Client
+from .config import CommitteeConfig, KeyPair, make_test_committee
+from .consensus.replica import Replica
+from .crypto.verifier import Verifier
+from .transport.local import FaultPlan, LocalNetwork
+
+
+@dataclass
+class LocalCommittee:
+    cfg: CommitteeConfig
+    keys: Dict[str, KeyPair]
+    net: LocalNetwork
+    replicas: List[Replica] = field(default_factory=list)
+    clients: List[Client] = field(default_factory=list)
+
+    @staticmethod
+    def build(
+        n: int = 4,
+        clients: int = 1,
+        fault_plan: Optional[FaultPlan] = None,
+        verifier_factory=None,
+        app_factory=KVStore,
+        **cfg_overrides,
+    ) -> "LocalCommittee":
+        cfg, keys = make_test_committee(n=n, clients=clients, **cfg_overrides)
+        net = LocalNetwork(fault_plan)
+        committee = LocalCommittee(cfg=cfg, keys=keys, net=net)
+        for rid in cfg.replica_ids:
+            committee.replicas.append(
+                Replica(
+                    node_id=rid,
+                    cfg=cfg,
+                    seed=keys[rid].seed,
+                    transport=net.endpoint(rid),
+                    app=app_factory(),
+                    verifier=verifier_factory() if verifier_factory else None,
+                )
+            )
+        for i in range(clients):
+            cid = f"c{i}"
+            committee.clients.append(
+                Client(
+                    client_id=cid,
+                    cfg=cfg,
+                    seed=keys[cid].seed,
+                    transport=net.endpoint(cid),
+                )
+            )
+        return committee
+
+    def start(self) -> None:
+        for r in self.replicas:
+            r.start()
+        for c in self.clients:
+            c.start()
+
+    async def stop(self) -> None:
+        for r in self.replicas:
+            await r.stop()
+        for c in self.clients:
+            await c.stop()
+
+    def replica(self, rid: str) -> Replica:
+        return next(r for r in self.replicas if r.id == rid)

@@ -292,4 +292,27 @@ class NewView(Message):
     pre_prepares: List[Dict[str, Any]] = field(default_factory=list)
 
 
+@dataclass
+class StateRequest(Message):
+    """Lagging replica asks a peer for the snapshot at a stable checkpoint
+    (state transfer — needed when a replica learns of a stable checkpoint
+    beyond what it has executed)."""
+
+    KIND: ClassVar[str] = "staterequest"
+
+    seq: int = 0
+
+
+@dataclass
+class StateResponse(Message):
+    """Snapshot at a stable checkpoint. The receiver validates
+    sha256(snapshot) against the 2f+1 checkpoint certificate digest, so the
+    responder need not be trusted."""
+
+    KIND: ClassVar[str] = "stateresponse"
+
+    seq: int = 0
+    snapshot: str = ""
+
+
 ALL_KINDS = tuple(sorted(_REGISTRY))

@@ -1,0 +1,18 @@
+"""Transport layer: how replicas and clients exchange wire bytes.
+
+Reference parity: L4 in SURVEY.md §1 — fire-and-forget HTTP POST with a
+path per message kind (consensusInterface.go) and an O(n) serial unicast
+Broadcast (node.go:107-129). Redesigned:
+
+- ``base.Transport`` — a minimal async interface (send/broadcast/inbox).
+- ``local.LocalNetwork`` — in-process committee: every node is an asyncio
+  queue; supports fault injection (drop/delay/duplicate/partition) — the
+  simulated transport the reference never had (its "cluster" was 4
+  localhost processes, run.bat:19-26) and the substrate for the
+  100-replica benchmark configs.
+- ``tcp`` (roadmap; lands with the multi-process milestone) —
+  length-prefixed JSON over asyncio TCP for real multi-process committees.
+"""
+
+from .base import Transport  # noqa: F401
+from .local import LocalEndpoint, LocalNetwork  # noqa: F401

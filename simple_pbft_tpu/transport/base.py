@@ -1,0 +1,29 @@
+"""Transport interface."""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Protocol
+
+
+class Transport(Protocol):
+    """One node's handle on the network. Sends are fire-and-forget (the
+    reference's semantics: http.Post with the response ignored,
+    node.go:101-129); reliability comes from the protocol layer (quorums,
+    retransmit-on-timeout), not the transport."""
+
+    node_id: str
+
+    async def send(self, dest: str, raw: bytes) -> None:
+        ...
+
+    async def broadcast(self, raw: bytes, dests: Iterable[str]) -> None:
+        """Send to every id in ``dests`` except self."""
+        ...
+
+    async def recv(self) -> bytes:
+        """Next inbound wire message (awaits until one arrives)."""
+        ...
+
+    def recv_nowait(self) -> Optional[bytes]:
+        """Drain one queued message without blocking, or None."""
+        ...

@@ -1,0 +1,97 @@
+"""In-process simulated network with fault injection.
+
+The whole committee (replicas + clients) lives in one process, one asyncio
+queue per node. This is the test/bench substrate SURVEY.md §4 calls for:
+the reference could only be "tested" by launching 4 OS processes and
+eyeballing logs; here an N-replica committee is a plain object, and the
+network can drop, delay, duplicate, or partition traffic deterministically.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Optional, Set, Tuple
+
+
+@dataclass
+class FaultPlan:
+    """Deterministic fault injection knobs (seeded RNG)."""
+
+    drop_rate: float = 0.0  # iid drop probability per message
+    delay_range: Tuple[float, float] = (0.0, 0.0)  # uniform delay seconds
+    duplicate_rate: float = 0.0
+    partitions: Set[Tuple[str, str]] = field(default_factory=set)
+    # directed (src, dst) pairs that are cut; use both directions for a
+    # symmetric partition
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        self.rng = random.Random(self.seed)
+
+    def cut(self, src: str, dst: str) -> None:
+        self.partitions.add((src, dst))
+        self.partitions.add((dst, src))
+
+    def heal(self) -> None:
+        self.partitions.clear()
+
+
+class LocalNetwork:
+    """Registry of in-process endpoints + the fault plan."""
+
+    def __init__(self, fault_plan: Optional[FaultPlan] = None) -> None:
+        self.queues: Dict[str, asyncio.Queue] = {}
+        self.faults = fault_plan or FaultPlan()
+        self.delivered = 0
+        self.dropped = 0
+
+    def endpoint(self, node_id: str) -> "LocalEndpoint":
+        if node_id not in self.queues:
+            self.queues[node_id] = asyncio.Queue()
+        return LocalEndpoint(node_id, self)
+
+    async def _deliver(self, src: str, dst: str, raw: bytes) -> None:
+        q = self.queues.get(dst)
+        if q is None:
+            return  # unknown destination: silently dropped (fire-and-forget)
+        f = self.faults
+        if (src, dst) in f.partitions or f.rng.random() < f.drop_rate:
+            self.dropped += 1
+            return
+        copies = 2 if f.rng.random() < f.duplicate_rate else 1
+        lo, hi = f.delay_range
+        for _ in range(copies):
+            delay = f.rng.uniform(lo, hi) if hi > 0 else 0.0
+            if delay > 0:
+                asyncio.get_running_loop().call_later(delay, q.put_nowait, raw)
+            else:
+                q.put_nowait(raw)
+            self.delivered += 1
+
+
+class LocalEndpoint:
+    """One node's transport handle on a LocalNetwork."""
+
+    def __init__(self, node_id: str, net: LocalNetwork) -> None:
+        self.node_id = node_id
+        self.net = net
+        self.queue = net.queues[node_id]
+
+    async def send(self, dest: str, raw: bytes) -> None:
+        await self.net._deliver(self.node_id, dest, raw)
+
+    async def broadcast(self, raw: bytes, dests: Iterable[str]) -> None:
+        for dest in dests:
+            if dest != self.node_id:
+                await self.net._deliver(self.node_id, dest, raw)
+
+    async def recv(self) -> bytes:
+        return await self.queue.get()
+
+    def recv_nowait(self) -> Optional[bytes]:
+        try:
+            return self.queue.get_nowait()
+        except asyncio.QueueEmpty:
+            return None

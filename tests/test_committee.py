@@ -1,0 +1,301 @@
+"""Integration tests: full committees on the in-process network.
+
+Behavioral-parity checkpoint vs the reference's only demonstrated scenario
+(SURVEY.md §3.2: 4 nodes, one client, request -> 3-phase commit -> reply),
+then everything the reference could not do: concurrent requests, larger
+committees, faulty replicas, duplicate/dropped messages.
+"""
+
+import asyncio
+
+import pytest
+
+from simple_pbft_tpu.committee import LocalCommittee
+from simple_pbft_tpu.transport.local import FaultPlan
+
+
+def run(coro, timeout=30):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def test_four_node_single_request():
+    """The reference's run.bat scenario: commit one request, reply to
+    client — but signed, event-driven, and with f+1 reply matching."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1)
+        com.start()
+        try:
+            result = await com.clients[0].submit("put k hello")
+            assert result == "ok"
+            result = await com.clients[0].submit("get k")
+            assert result == "hello"
+        finally:
+            await com.stop()
+        # all replicas executed both blocks and agree on state
+        digests = {r.app.state_digest() for r in com.replicas}
+        assert len(digests) == 1
+        assert all(r.executed_seq == 2 for r in com.replicas)
+
+    run(scenario())
+
+
+def test_concurrent_requests_pipeline():
+    """Many in-flight requests (the reference serialized rounds via its
+    scalar CurrentState; here seqs pipeline)."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1)
+        com.start()
+        try:
+            results = await asyncio.gather(
+                *(com.clients[0].submit(f"put k{i} v{i}") for i in range(20))
+            )
+            assert results == ["ok"] * 20
+        finally:
+            await com.stop()
+        primary = com.replica("r0")
+        assert primary.metrics["committed_requests"] == 20
+        # batching: fewer blocks than requests (drain sweeps coalesce)
+        assert primary.metrics["committed_blocks"] <= 20
+        digests = {r.app.state_digest() for r in com.replicas}
+        assert len(digests) == 1
+
+    run(scenario())
+
+
+def test_seven_node_committee():
+    """n=7, f=2: quorums of 5."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=7, clients=1)
+        com.start()
+        try:
+            assert await com.clients[0].submit("put a 1") == "ok"
+        finally:
+            await com.stop()
+        assert sum(r.executed_seq == 1 for r in com.replicas) == 7
+
+    run(scenario())
+
+
+def test_commits_with_f_crashed_backups():
+    """f crashed backups must not block progress (quorum 2f+1 of n)."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1)
+        # crash r3 by never starting it
+        for r in com.replicas:
+            if r.id != "r3":
+                r.start()
+        for c in com.clients:
+            c.start()
+        try:
+            assert await com.clients[0].submit("put a 1") == "ok"
+        finally:
+            await com.stop()
+
+    run(scenario())
+
+
+def test_progress_under_message_duplication():
+    async def scenario():
+        com = LocalCommittee.build(
+            n=4, clients=1, fault_plan=FaultPlan(duplicate_rate=0.5, seed=7)
+        )
+        com.start()
+        try:
+            for i in range(5):
+                assert await com.clients[0].submit(f"put x{i} {i}") == "ok"
+        finally:
+            await com.stop()
+        digests = {r.app.state_digest() for r in com.replicas}
+        assert len(digests) == 1
+
+    run(scenario())
+
+
+def test_progress_under_light_message_loss():
+    """Client retransmission + quorum redundancy ride out 5% drop."""
+
+    async def scenario():
+        com = LocalCommittee.build(
+            n=4, clients=1, fault_plan=FaultPlan(drop_rate=0.05, seed=3)
+        )
+        com.start()
+        try:
+            for i in range(5):
+                assert (
+                    await com.clients[0].submit(f"put y{i} {i}") == "ok"
+                )
+        finally:
+            await com.stop()
+
+    run(scenario())
+
+
+def test_duplicate_request_reexecutes_nothing():
+    """At-most-once execution: a retransmitted request must not re-apply."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1)
+        com.start()
+        try:
+            await com.clients[0].submit("put k 1")
+            # forge a retransmission of timestamp 1 by sending the same
+            # signed request again straight to the primary
+            from simple_pbft_tpu.messages import Request
+
+            req = Request(client_id="c0", timestamp=1, operation="put k 1")
+            com.clients[0].signer.sign_msg(req)
+            await com.clients[0].transport.send("r0", req.to_wire())
+            await asyncio.sleep(0.2)
+        finally:
+            await com.stop()
+        primary = com.replica("r0")
+        assert primary.metrics["committed_requests"] == 1
+
+    run(scenario())
+
+
+def test_unsigned_traffic_rejected():
+    """Messages with missing/garbage signatures never reach consensus."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1)
+        com.start()
+        try:
+            from simple_pbft_tpu.messages import PrePrepare, Request
+
+            # unsigned request straight at the primary
+            req = Request(
+                sender="c0", client_id="c0", timestamp=99, operation="put z 9"
+            )
+            ep = com.net.endpoint("intruder")
+            await ep.send("r0", req.to_wire())
+            # bogus pre-prepare from a non-member
+            pp = PrePrepare(
+                sender="intruder", view=0, seq=1, digest="d", block=[]
+            )
+            await ep.send("r1", pp.to_wire())
+            await asyncio.sleep(0.2)
+        finally:
+            await com.stop()
+        assert all(r.metrics["committed_requests"] == 0 for r in com.replicas)
+        assert com.replica("r0").metrics["bad_sig"] >= 1
+
+    run(scenario())
+
+
+def test_checkpoint_advances_watermark_and_gcs():
+    async def scenario():
+        com = LocalCommittee.build(
+            n=4, clients=1, checkpoint_interval=2, watermark_window=64
+        )
+        com.start()
+        try:
+            for i in range(6):
+                await com.clients[0].submit(f"put c{i} {i}")
+            await asyncio.sleep(0.3)  # let checkpoint gossip settle
+        finally:
+            await com.stop()
+        for r in com.replicas:
+            assert r.stable_seq >= 2, (r.id, r.stable_seq)
+            # GC dropped instances at/below the watermark
+            assert all(seq > r.stable_seq for (_, seq) in r.instances)
+
+    run(scenario())
+
+
+def test_client_keys_cannot_join_quorums():
+    """A Byzantine primary signing votes as clients must not reach quorum
+    (clients' keys are known committee-wide but carry no consensus role)."""
+
+    async def scenario():
+        from simple_pbft_tpu.crypto.signer import Signer
+        from simple_pbft_tpu.messages import Commit, PrePrepare, Prepare
+
+        com = LocalCommittee.build(n=4, clients=2)
+        # only r0 (Byzantine primary) + r1 honest; r2/r3 "crashed"
+        com.replica("r0").start()
+        com.replica("r1").start()
+        for c in com.clients:
+            c.start()
+        try:
+            # r0 proposes an empty block legitimately, then forges
+            # prepare/commit votes as c0 and c1 toward r1
+            block = []
+            pp = PrePrepare(
+                view=0, seq=1, digest=PrePrepare.block_digest(block), block=block
+            )
+            r0 = com.replica("r0")
+            r0.signer.sign_msg(pp)
+            await r0.transport.send("r1", pp.to_wire())
+            for fake in ["c0", "c1"]:
+                signer = Signer(fake, com.keys[fake].seed)
+                for cls in (Prepare, Commit):
+                    vote = cls(view=0, seq=1, digest=pp.digest)
+                    signer.sign_msg(vote)
+                    await r0.transport.send("r1", vote.to_wire())
+            await asyncio.sleep(0.3)
+        finally:
+            await com.stop()
+        r1 = com.replica("r1")
+        assert r1.metrics["committed_blocks"] == 0
+        assert r1.metrics["bad_sig"] >= 4  # the forged client votes
+
+    run(scenario())
+
+
+def test_client_impersonation_rejected():
+    """c1 signing a request that claims client_id=c0 must be dropped."""
+
+    async def scenario():
+        from simple_pbft_tpu.messages import Request
+
+        com = LocalCommittee.build(n=4, clients=2)
+        com.start()
+        try:
+            req = Request(client_id="c0", timestamp=5, operation="put k evil")
+            com.clients[1].signer.sign_msg(req)  # signs as c1
+            await com.clients[1].transport.send("r0", req.to_wire())
+            await asyncio.sleep(0.2)
+        finally:
+            await com.stop()
+        assert all(r.metrics["committed_requests"] == 0 for r in com.replicas)
+
+    run(scenario())
+
+
+def test_lagging_replica_state_transfer():
+    """A replica partitioned through several checkpoints must catch up via
+    verified snapshot transfer when the partition heals."""
+
+    async def scenario():
+        plan = FaultPlan()
+        com = LocalCommittee.build(
+            n=4, clients=1, fault_plan=plan, checkpoint_interval=2
+        )
+        # partition r3 from everyone
+        for other in ["r0", "r1", "r2", "c0"]:
+            plan.cut("r3", other)
+        com.start()
+        try:
+            for i in range(6):
+                assert await com.clients[0].submit(f"put s{i} {i}") == "ok"
+            r3 = com.replica("r3")
+            assert r3.executed_seq == 0  # fully partitioned
+            plan.heal()
+            # next round of traffic brings checkpoint gossip + state sync
+            for i in range(6, 10):
+                assert await com.clients[0].submit(f"put s{i} {i}") == "ok"
+            await asyncio.sleep(0.5)
+        finally:
+            await com.stop()
+        r3 = com.replica("r3")
+        assert r3.metrics["state_syncs"] >= 1
+        assert r3.executed_seq >= 6
+        # r3's data matches the quorum's
+        assert r3.app.data == com.replica("r0").app.data
+
+    run(scenario())
