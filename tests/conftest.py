@@ -11,7 +11,11 @@ import time in conftest.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu (not setdefault): the ambient environment pins
+# JAX_PLATFORMS to the single real TPU chip's tunnel, which must never be
+# used for unit tests (each jit would remote-compile over the tunnel, and
+# a killed test run wedges the device for every other process).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
