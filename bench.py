@@ -1,0 +1,101 @@
+"""Benchmark: batched Ed25519 verification throughput on the attached chip.
+
+Headline metric (BASELINE.md): Ed25519 verifies/sec on one chip; target is
+>= 1,000,000/s (`vs_baseline` is value / 1e6 — the reference itself verifies
+zero signatures, SURVEY.md §6, so the target ratio is the honest comparison).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Methodology: sign a small set of distinct messages (pure-Python RFC 8032),
+tile to the bench batch, stage prepared arrays on device, then time
+steady-state jitted verify passes with block_until_ready. Host batch prep
+is excluded from the headline (it overlaps with device compute in the
+pipelined runtime) but reported on stderr for honesty.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    if "--smoke" in sys.argv:
+        # CPU, tiny batch: CI-checkable in seconds. The ambient
+        # sitecustomize force-registers the axon TPU backend (overriding
+        # the JAX_PLATFORMS env var), so override in-process before any
+        # backend initializes.
+        jax.config.update("jax_platforms", "cpu")
+        os.environ.setdefault("BENCH_BATCH", "8")
+
+    from simple_pbft_tpu.crypto import ed25519_cpu as ref
+    from simple_pbft_tpu.crypto.verifier import BatchItem
+    from simple_pbft_tpu.crypto.tpu_verifier import (
+        BUCKETS,
+        prepare_batch,
+        verify_kernel,
+    )
+
+    batch = int(os.environ.get("BENCH_BATCH", str(BUCKETS[-1])))
+    distinct = min(batch, 64)
+
+    items = []
+    for i in range(distinct):
+        seed = bytes([i % 251]) * 32
+        msg = b"bench vote %d" % i
+        items.append(BatchItem(ref.public_key(seed), msg, ref.sign(seed, msg)))
+
+    t0 = time.perf_counter()
+    prep = prepare_batch(items)
+    prep_per_item = (time.perf_counter() - t0) / distinct
+
+    reps = max(1, batch // distinct)
+    batch = distinct * reps  # keep the rate honest when batch % distinct != 0
+    arrays = [
+        jax.device_put(np.concatenate([a] * reps, axis=0)) for a in prep.arrays()
+    ]
+
+    fn = jax.jit(verify_kernel)
+    t0 = time.perf_counter()
+    verdict = np.asarray(fn(*arrays))
+    compile_s = time.perf_counter() - t0
+    assert verdict.all(), "bench batch must verify valid"
+
+    # steady state: run until >= 3 s of device time or 30 iters
+    iters = 0
+    t0 = time.perf_counter()
+    while True:
+        out = fn(*arrays)
+        iters += 1
+        if iters >= 30 or (iters >= 3 and time.perf_counter() - t0 > 3.0):
+            break
+    out.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    value = batch * iters / elapsed
+    print(
+        f"batch={batch} iters={iters} elapsed={elapsed:.3f}s "
+        f"compile={compile_s:.1f}s host_prep={prep_per_item*1e6:.1f}us/item "
+        f"device={jax.devices()[0].platform}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_verifies_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "verifies/s",
+                "vs_baseline": round(value / 1_000_000, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

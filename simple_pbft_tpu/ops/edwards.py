@@ -40,10 +40,6 @@ IDENTITY = _point_const(ref.IDENTITY)  # (4, 17)
 BASE = _point_const(ref.B)
 
 
-def identity_like(batch_shape: Tuple[int, ...]) -> jnp.ndarray:
-    return jnp.broadcast_to(jnp.asarray(IDENTITY), batch_shape + (4, 17))
-
-
 # -- coordinate accessors ---------------------------------------------------
 
 
@@ -116,7 +112,9 @@ def double_scalar_mul_base(
     iterations — constant shape, no data-dependent control flow.
     """
     base = jnp.broadcast_to(jnp.asarray(BASE), q.shape)
-    ident = jnp.broadcast_to(jnp.asarray(IDENTITY), q.shape)
+    # derive from q (not broadcast a constant) so the loop carry inherits
+    # q's varying manual axes under shard_map
+    ident = q * 0 + jnp.asarray(IDENTITY)
     table = jnp.stack([ident, base, q, point_add(base, q)], axis=-3)
 
     def body(i, acc):

@@ -1,0 +1,9 @@
+"""Multi-chip parallelism: sharded signature verification + quorum counts.
+
+The reference's only parallelism is 4 OS processes + goroutines
+(SURVEY.md §2.2); its TPU-native translation is data parallelism over the
+signature batch, sharded across an ICI-connected device mesh, with the
+quorum-certificate reduction expressed as an XLA collective (psum).
+"""
+
+from .sharded_verify import make_quorum_step  # noqa: F401

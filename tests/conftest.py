@@ -5,19 +5,24 @@ jitted code paths on CPU, with 8 virtual devices so the shard_map /
 multi-chip sharding paths are genuinely executed (see SURVEY.md §7 and the
 driver's dryrun_multichip contract).
 
-Must run before jax is imported anywhere — hence env vars set at module
-import time in conftest.
+The ambient environment pre-imports jax and registers an 'axon' backend
+(the tunnel to the one real TPU chip) via sitecustomize, overriding
+JAX_PLATFORMS — setting env vars here is too late. Unit tests must never
+run over the tunnel (each jit would remote-compile, and a killed test run
+wedges the device for every other process), so we override the platform
+in-process: XLA_FLAGS must be in the env before the CPU backend
+initializes, and jax.config wins over the sitecustomize registration as
+long as no backend has been used yet (none has at conftest import).
 """
 
 import os
 
-# FORCE cpu (not setdefault): the ambient environment pins
-# JAX_PLATFORMS to the single real TPU chip's tunnel, which must never be
-# used for unit tests (each jit would remote-compile over the tunnel, and
-# a killed test run wedges the device for every other process).
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

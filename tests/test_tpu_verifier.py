@@ -1,0 +1,129 @@
+"""TPU (JAX) batched verifier vs the pure-Python RFC 8032 oracle.
+
+Covers SURVEY.md §4's crypto-plane test strategy: RFC 8032 known-answer
+vectors, adversarial inputs (corrupted bits, non-canonical encodings,
+wrong lengths), per-position verdict bitmaps under batching, and the
+shard_map quorum step on the virtual 8-device mesh.
+"""
+
+import numpy as np
+import pytest
+
+from simple_pbft_tpu.crypto import ed25519_cpu as ref
+from simple_pbft_tpu.crypto.verifier import BatchItem
+from simple_pbft_tpu.crypto.tpu_verifier import (
+    TpuVerifier,
+    prepare_batch,
+    verify_kernel,
+)
+
+# RFC 8032 §7.1 test vectors 1-3 (seed, pubkey, msg, sig)
+RFC8032_VECTORS = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+@pytest.fixture(scope="module")
+def verifier():
+    return TpuVerifier()
+
+
+def _signed(i: int, msg: bytes):
+    seed = bytes([i]) * 32
+    return BatchItem(ref.public_key(seed), msg, ref.sign(seed, msg))
+
+
+def test_rfc8032_vectors(verifier):
+    items = [
+        BatchItem(bytes.fromhex(pk), bytes.fromhex(msg), bytes.fromhex(sig))
+        for _, pk, msg, sig in RFC8032_VECTORS
+    ]
+    assert verifier.verify_batch(items) == [True] * len(items)
+
+
+def test_bitmap_positions_and_adversarial(verifier):
+    """One mixed batch: verdict positions must map 1:1 to items, agreeing
+    with the CPU oracle on every adversarial case."""
+    good = [_signed(i, b"vote %d" % i) for i in range(4)]
+    bad_sig = bytearray(good[0].sig)
+    bad_sig[1] ^= 0x40
+    noncanon_s = good[2].sig[:32] + (
+        (int.from_bytes(good[2].sig[32:], "little") + ref.L).to_bytes(32, "little")
+    )
+    items = [
+        good[0],
+        BatchItem(good[0].pubkey, good[0].msg, bytes(bad_sig)),  # flipped bit
+        good[1],
+        BatchItem(good[1].pubkey, b"forged", good[1].sig),  # wrong msg
+        BatchItem(good[2].pubkey, good[2].msg, noncanon_s),  # S >= L
+        BatchItem(good[3].pubkey[:16], good[3].msg, good[3].sig),  # bad len
+        BatchItem(b"\xff" * 32, good[3].msg, good[3].sig),  # y >= p
+        good[3],
+    ]
+    got = verifier.verify_batch(items)
+    oracle = [ref.verify(i.pubkey, i.msg, i.sig) for i in items]
+    assert got == oracle == [True, False, True, False, False, False, False, True]
+
+
+def test_swapped_keys_rejected(verifier):
+    a, b = _signed(1, b"m1"), _signed(2, b"m2")
+    items = [BatchItem(b.pubkey, a.msg, a.sig), BatchItem(a.pubkey, b.msg, b.sig)]
+    assert verifier.verify_batch(items) == [False, False]
+
+
+def test_bucket_padding_indifferent(verifier):
+    """Verdicts must not depend on padding rows (batch of 3 -> bucket 8)."""
+    items = [_signed(i, b"pad %d" % i) for i in range(3)]
+    assert verifier.verify_batch(items) == [True, True, True]
+
+
+def test_empty_batch(verifier):
+    assert verifier.verify_batch([]) == []
+
+
+def test_sharded_quorum_step():
+    """shard_map verify + psum tally over the virtual 8-device mesh."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from simple_pbft_tpu.parallel import make_quorum_step
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("dp",))
+    n_inst = 2
+    items = [_signed(i % 8, b"inst vote %d" % i) for i in range(16)]
+    # corrupt one vote of instance 0
+    broken = bytearray(items[0].sig)
+    broken[3] ^= 1
+    items[0] = BatchItem(items[0].pubkey, items[0].msg, bytes(broken))
+
+    prep = prepare_batch(items)
+    inst = np.arange(16, dtype=np.int32) % n_inst
+    onehot = np.eye(n_inst, dtype=np.int32)[inst]
+    sharding = NamedSharding(mesh, P("dp"))
+    args = [jax.device_put(a, sharding) for a in prep.arrays()]
+    args.append(jax.device_put(onehot, sharding))
+
+    verdict, counts = make_quorum_step(mesh)(*args)
+    verdict, counts = np.asarray(verdict), np.asarray(counts)
+    assert not verdict[0] and verdict[1:].all()
+    assert counts.tolist() == [7, 8]  # one invalid vote lost from instance 0
