@@ -34,25 +34,34 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
         os.environ.setdefault("BENCH_BATCH", "8")
 
+    import jax.numpy as jnp
+
+    from simple_pbft_tpu.ops import comb
     from simple_pbft_tpu.crypto import ed25519_cpu as ref
     from simple_pbft_tpu.crypto.verifier import BatchItem
     from simple_pbft_tpu.crypto.tpu_verifier import (
         BUCKETS,
-        prepare_batch,
-        verify_kernel,
+        KeyBank,
+        prepare_comb_batch,
     )
 
     batch = int(os.environ.get("BENCH_BATCH", str(BUCKETS[-1])))
+    # comb kernel's batch inversion needs a power-of-two batch
+    batch = 1 << max(0, batch - 1).bit_length()
+    # committee-shaped workload: 16 signers (BASELINE config 2), distinct
+    # messages per signer
+    n_signers = int(os.environ.get("BENCH_SIGNERS", "16"))
     distinct = min(batch, 64)
 
     items = []
     for i in range(distinct):
-        seed = bytes([i % 251]) * 32
+        seed = bytes([i % n_signers]) * 32
         msg = b"bench vote %d" % i
         items.append(BatchItem(ref.public_key(seed), msg, ref.sign(seed, msg)))
 
+    bank = KeyBank()
     t0 = time.perf_counter()
-    prep = prepare_batch(items)
+    prep, _fallback = prepare_comb_batch(items, bank)
     prep_per_item = (time.perf_counter() - t0) / distinct
 
     reps = max(1, batch // distinct)
@@ -60,8 +69,15 @@ def main() -> None:
     arrays = [
         jax.device_put(np.concatenate([a] * reps, axis=0)) for a in prep.arrays()
     ]
+    tables = bank.device_tables()
+    b_table = jnp.asarray(comb.base_table())
 
-    fn = jax.jit(verify_kernel)
+    def fn(s_nib, k_nib, a_idx, r_y, r_sign, precheck):
+        return comb.comb_verify_kernel(
+            s_nib, k_nib, a_idx, tables, b_table, r_y, r_sign, precheck
+        )
+
+    fn = jax.jit(fn)
     t0 = time.perf_counter()
     verdict = np.asarray(fn(*arrays))
     compile_s = time.perf_counter() - t0

@@ -28,12 +28,13 @@ double-scalar multiplication and an equality — no second ladder.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops import comb
 from ..ops import edwards as ed
 from ..ops import field25519 as fe
 from . import ed25519_cpu as ref
@@ -82,6 +83,18 @@ def _bits_msb_first_np(le_bytes: np.ndarray) -> np.ndarray:
     return bits[:, ::-1].astype(np.int32)
 
 
+def _pad_batch_arrays(arrays, n: int, size: int):
+    """Zero-pad each array's leading batch dim from n to size."""
+    assert size >= n, f"pad target {size} < batch {n}"
+    pad = size - n
+
+    def pz(a):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths)
+
+    return tuple(pz(a) for a in arrays)
+
+
 class PreparedBatch:
     """Fixed-shape device-ready arrays for one verify batch of size n
     (pre-padding). Field order matches _device_verify's signature."""
@@ -112,25 +125,9 @@ class PreparedBatch:
     def padded(self, size: int) -> "PreparedBatch":
         """Zero-pad every array's batch dim up to `size`. Padding rows get
         precheck=False, so their (garbage) device verdicts are masked out."""
-        assert size >= self.n
-        pad = size - self.n
-        if pad == 0:
+        if size == self.n:
             return self
-
-        def pz(a):
-            widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
-            return np.pad(a, widths)
-
-        return PreparedBatch(
-            self.n,
-            pz(self.a_y),
-            pz(self.a_sign),
-            pz(self.r_y),
-            pz(self.r_sign),
-            pz(self.s_bits),
-            pz(self.k_bits),
-            pz(self.precheck),
-        )
+        return PreparedBatch(self.n, *_pad_batch_arrays(self.arrays(), self.n, size))
 
 
 def prepare_batch(items: Sequence[BatchItem]) -> PreparedBatch:
@@ -203,33 +200,185 @@ def _bucket_size(n: int) -> int:
     return BUCKETS[-1]
 
 
+# ---------------------------------------------------------------------------
+# Comb-path host prep: committee pubkey table bank + per-batch scalars
+# ---------------------------------------------------------------------------
+
+
+class CombBatch:
+    """Device-ready arrays for the comb kernel (pre-padding)."""
+
+    __slots__ = ("n", "s_nib", "k_nib", "a_idx", "r_y", "r_sign", "precheck")
+
+    def __init__(self, n, s_nib, k_nib, a_idx, r_y, r_sign, precheck):
+        self.n = n
+        self.s_nib = s_nib
+        self.k_nib = k_nib
+        self.a_idx = a_idx
+        self.r_y = r_y
+        self.r_sign = r_sign
+        self.precheck = precheck
+
+    def arrays(self):
+        return (self.s_nib, self.k_nib, self.a_idx, self.r_y, self.r_sign, self.precheck)
+
+    def padded(self, size: int) -> "CombBatch":
+        if size == self.n:
+            return self
+        return CombBatch(self.n, *_pad_batch_arrays(self.arrays(), self.n, size))
+
+
+class KeyBank:
+    """Cache of per-pubkey comb tables (the committee's key set).
+
+    PBFT pubkeys are few and endlessly reused, so each is decompressed and
+    expanded into a Niels comb table once on the host (exact bigints) and
+    kept on device. The bank's capacity grows in powers of two so kernel
+    shapes (and thus compiles) change only on committee growth.
+
+    `max_keys` bounds the bank: a Byzantine sender must not be able to
+    grow device memory (~200 KB/key) and force recompiles by spraying
+    fresh valid curve points through the Verifier seam. Keys beyond the
+    cap report UNCACHED and are verified on the CPU fallback path.
+    """
+
+    UNCACHED = -2
+
+    def __init__(self, initial_capacity: int = 8, max_keys: int = 1024):
+        self._index: Dict[bytes, int] = {}
+        self._invalid_cache: set = set()
+        self._max_keys = max_keys
+        self._cap = initial_capacity
+        self._np = np.zeros((self._cap, comb.NPOS, comb.WINDOW, 3, 17), np.int32)
+        self._dev = None
+        self._dirty = True
+
+    def lookup(self, pubkey: bytes) -> int:
+        """-> table row for pubkey, -1 if the key is invalid (bad length /
+        not a curve point), or UNCACHED if the bank is full. Builds and
+        caches the table on miss."""
+        idx = self._index.get(pubkey)
+        if idx is not None:
+            return idx
+        if len(pubkey) != 32 or pubkey in self._invalid_cache:
+            return -1
+        pt = ref.point_decompress(pubkey)
+        if pt is None:
+            if len(self._invalid_cache) < 4096:  # bounded negative cache
+                self._invalid_cache.add(pubkey)
+            return -1
+        idx = len(self._index)
+        if idx >= self._max_keys:
+            return self.UNCACHED
+        if idx >= self._cap:
+            self._cap = min(self._cap * 2, self._max_keys)
+            grown = np.zeros((self._cap,) + self._np.shape[1:], np.int32)
+            grown[:idx] = self._np[:idx]
+            self._np = grown
+        self._np[idx] = comb.comb_table_np(pt)
+        self._index[pubkey] = idx
+        self._dirty = True
+        return idx
+
+    def device_tables(self) -> jnp.ndarray:
+        if self._dirty or self._dev is None:
+            self._dev = jnp.asarray(self._np)
+            self._dirty = False
+        return self._dev
+
+
+def prepare_comb_batch(
+    items: Sequence[BatchItem], bank: KeyBank
+) -> "tuple[CombBatch, List[int]]":
+    """Wire bytes -> comb-kernel arrays, registering pubkeys in `bank`.
+
+    Returns (batch, fallback): `fallback` lists item positions whose
+    pubkey is valid but over the bank's cap — the caller must verify
+    those on the CPU path (their device rows are masked out)."""
+    n = len(items)
+    s_raw = np.zeros((n, 32), dtype=np.uint8)
+    k_raw = np.zeros((n, 32), dtype=np.uint8)
+    r_raw = np.zeros((n, 32), dtype=np.uint8)
+    a_idx = np.zeros(n, dtype=np.int32)
+    ok = np.ones(n, dtype=bool)
+    fallback: List[int] = []
+
+    for i, it in enumerate(items):
+        idx = bank.lookup(it.pubkey)
+        if idx == KeyBank.UNCACHED:
+            ok[i] = False
+            fallback.append(i)
+            continue
+        if idx < 0 or len(it.sig) != 64:
+            ok[i] = False
+            continue
+        a_idx[i] = idx
+        r_raw[i] = np.frombuffer(it.sig[:32], dtype=np.uint8)
+        s_raw[i] = np.frombuffer(it.sig[32:], dtype=np.uint8)
+        k = ref.challenge_scalar(it.sig[:32], it.pubkey, it.msg)
+        k_raw[i] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
+
+    ok &= ~_ge_l_np(s_raw)
+    ok &= ~_ge_p_np(r_raw)
+
+    batch = CombBatch(
+        n,
+        comb.nibbles_np(s_raw),
+        comb.nibbles_np(k_raw),
+        a_idx,
+        fe.bytes32_to_limbs_np(r_raw),
+        fe.sign_bits_np(r_raw),
+        ok,
+    )
+    return batch, fallback
+
+
 class TpuVerifier:
     """The `tpu` backend behind the crypto.Verifier seam.
 
+    Default mode is the comb engine (ops/comb.py): cached per-pubkey comb
+    tables, zero doublings, no on-device decompression, batch-amortized
+    inversion. `mode="ladder"` selects the self-contained Straus ladder
+    (no key cache — useful when pubkeys are unbounded).
+
     Pads drained batches to bucketed sizes, runs one jitted device pass per
-    chunk, and returns the per-item bitmap. `devices=None` uses JAX's
-    default device; pass a `jax.sharding.Mesh` via `mesh` to shard the
-    batch dimension across chips (verdict gather rides ICI).
+    chunk, and returns the per-item bitmap. Pass a `jax.sharding.Mesh` via
+    `mesh` to shard the batch dimension across chips (tables replicate;
+    verdict gather rides ICI).
     """
 
     name = "tpu"
 
-    def __init__(self, mesh: Optional[jax.sharding.Mesh] = None):
+    def __init__(
+        self, mesh: Optional[jax.sharding.Mesh] = None, mode: str = "comb"
+    ):
+        assert mode in ("comb", "ladder")
         self._mesh = mesh
+        self._mode = mode
+        self._bank = KeyBank() if mode == "comb" else None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             axis = mesh.axis_names[0]
-            self._data_sharding = NamedSharding(mesh, P(axis))
-            self._fn = jax.jit(
-                verify_kernel,
-                in_shardings=(self._data_sharding,) * 7,
-                out_shardings=NamedSharding(mesh, P(axis)),
-            )
+            data = NamedSharding(mesh, P(axis))
+            repl = NamedSharding(mesh, P())
+            if mode == "comb":
+                self._fn = jax.jit(
+                    comb.comb_verify_kernel,
+                    in_shardings=(data, data, data, repl, repl, data, data, data),
+                    out_shardings=data,
+                )
+            else:
+                self._fn = jax.jit(
+                    verify_kernel,
+                    in_shardings=(data,) * 7,
+                    out_shardings=data,
+                )
             self._align = int(np.prod(mesh.devices.shape))
         else:
-            self._data_sharding = None
-            self._fn = jax.jit(verify_kernel)
+            self._fn = jax.jit(
+                comb.comb_verify_kernel if mode == "comb" else verify_kernel
+            )
             self._align = 1
 
     def verify_batch(self, items: Sequence[BatchItem]) -> List[bool]:
@@ -243,8 +392,22 @@ class TpuVerifier:
         return out
 
     def _verify_chunk(self, items: Sequence[BatchItem]) -> List[bool]:
-        prep = prepare_batch(items)
-        size = _bucket_size(max(prep.n, self._align))
-        padded = prep.padded(size)
-        verdict = np.asarray(self._fn(*padded.arrays()))
+        size = _bucket_size(max(len(items), self._align))
+        if self._mode == "comb":
+            prep, fallback = prepare_comb_batch(items, self._bank)
+            prep = prep.padded(size)
+            s_nib, k_nib, a_idx, r_y, r_sign, precheck = prep.arrays()
+            tables = self._bank.device_tables()
+            b_table = comb.base_table_device()
+            # np.array (copy): fallback rows below are written in place
+            verdict = np.array(
+                self._fn(s_nib, k_nib, a_idx, tables, b_table, r_y, r_sign, precheck)
+            )
+            if fallback:  # keys over the bank cap: CPU path
+                for i in fallback:
+                    it = items[i]
+                    verdict[i] = ref.verify(it.pubkey, it.msg, it.sig)
+        else:
+            prep = prepare_batch(items).padded(size)
+            verdict = np.asarray(self._fn(*prep.arrays()))
         return verdict[: prep.n].tolist()

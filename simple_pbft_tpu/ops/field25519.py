@@ -85,8 +85,10 @@ def zeros_like(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _ripple(x: jnp.ndarray) -> jnp.ndarray:
-    """One carry pass: limbs -> [0, 2^15), carry-out folded in as *19 on
-    limb 0 (2^255 ≡ 19 mod p). Input limbs must be nonnegative int32."""
+    """One sequential carry pass: limbs -> [0, 2^15), carry-out folded in
+    as *19 on limb 0 (2^255 ≡ 19 mod p). Exact but latency-bound (17
+    dependent steps) — used only by `normalize_strict` / `to_canonical`,
+    never on the hot path."""
     outs: List[jnp.ndarray] = []
     c = jnp.zeros_like(x[..., 0])
     for i in range(NLIMB):
@@ -97,20 +99,43 @@ def _ripple(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack(outs, axis=-1)
 
 
-def normalize(x: jnp.ndarray) -> jnp.ndarray:
-    """Two carry passes -> weak normal form (limb0 < 2^15 + 19).
-
-    Bound: after pass 1 every limb < 2^15 except limb0 < 2^15 + 19*C where
-    C < 2^16 (largest carry chain from 2^21-bounded mul columns after the
-    *19 fold, < 2^26 inputs). Pass 2 reduces limb0's excess; its own
-    carry-out is ≤ 1, folding ≤ 19 back into limb0.
-    """
+def normalize_strict(x: jnp.ndarray) -> jnp.ndarray:
+    """Two sequential carry passes -> strict weak form (limbs 1..16 in
+    [0, 2^15), limb0 < 2^15 + 19). Needed before to_canonical's
+    borrow-ripple subtraction, which assumes in-range limbs."""
     return _ripple(_ripple(x))
+
+
+def _carry_pass(x: jnp.ndarray) -> jnp.ndarray:
+    """One PARALLEL carry pass over the whole limb axis (5 vectorized VPU
+    ops, no sequential dependency across limbs): every limb sheds its
+    carry to its neighbor simultaneously; the top carry folds into limb 0
+    as *19."""
+    c = x >> RADIX
+    shifted = jnp.concatenate([19 * c[..., -1:], c[..., :-1]], axis=-1)
+    return (x & MASK) + shifted
+
+
+def normalize(x: jnp.ndarray) -> jnp.ndarray:
+    """Two parallel carry passes -> relaxed weak form. Hot-path invariant
+    (inputs nonnegative, limbs < 2^26 — the mul-fold bound):
+
+    - pass 1: carries < 2^11, so limbs < 2^15 + 2^11 (limb 0 gets 19*c
+      < 2^16.3, still < 2^17);
+    - pass 2: carries <= 2 (limb 1 gets <= 2^2), so limbs land in
+      [0, 2^15 + 2^11) with limb 0 < 2^15 + 19*2.
+
+    Relaxed-weak inputs keep the next mul exact in int32:
+    (2^15 + 2^11)^2 < 1.14 * 2^30 < 2^31, and the lo/hi column sums stay
+    17*(2^15 + 1.14*2^16) < 2^21. `to_canonical` re-normalizes strictly,
+    so comparisons are unaffected.
+    """
+    return _carry_pass(_carry_pass(x))
 
 
 def to_canonical(x: jnp.ndarray) -> jnp.ndarray:
     """Weak form -> unique representative in [0, p)."""
-    x = normalize(x)
+    x = normalize_strict(x)
     # weak value < 2^255 + 18 < 2p, so at most one subtraction of p needed —
     # but limb0 may hold up to 2^15+18 (value can slightly exceed 2^255-1),
     # subtract with borrow and select.
@@ -133,34 +158,57 @@ def to_canonical(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return normalize(a + b)
+    """Sum < 2^16 + 2^12 per limb, so ONE parallel carry pass suffices
+    (carries <= 2) to return to relaxed weak form."""
+    return _carry_pass(a + b)
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """a - b, computed as a + 2p - b to stay nonnegative."""
-    return normalize(a + jnp.asarray(TWO_P) - b)
+    """a - b, computed as a + 2p - b to stay nonnegative (< 2^17 per
+    limb, one carry pass)."""
+    return _carry_pass(a + jnp.asarray(TWO_P) - b)
 
 
 def neg(a: jnp.ndarray) -> jnp.ndarray:
-    return normalize(jnp.asarray(TWO_P) - a)
+    return _carry_pass(jnp.asarray(TWO_P) - a)
+
+
+def _antidiagonal_sums(m: jnp.ndarray) -> jnp.ndarray:
+    """(..., 17, 17) -> (..., 34) with out[c] = sum_i m[i, c - i].
+
+    The skew trick, in 3 XLA ops instead of 17 dynamic-update-slices:
+    pad rows to width 35 and flatten; element (i, j) sits at 35i + j =
+    34i + (i + j), so reshaping the first 17*34 entries to (17, 34) puts
+    every (i, j) with i + j = c in column c of some row (out-of-band
+    entries land in the zero padding). Sum over rows.
+    """
+    padded = jnp.pad(m, [(0, 0)] * (m.ndim - 2) + [(0, 0), (0, 2 * NLIMB + 1 - NLIMB)])
+    flat = padded.reshape(*m.shape[:-2], NLIMB * (2 * NLIMB + 1))
+    skewed = flat[..., : NLIMB * 2 * NLIMB].reshape(*m.shape[:-2], NLIMB, 2 * NLIMB)
+    return skewed.sum(axis=-2)
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Field multiply: schoolbook convolution with split accumulation.
 
-    prod[i,j] = a_i * b_j < 2^31 (weak-form inputs). Split each product
-    into 15-bit lo and ≤16-bit hi; lo accumulates into column i+j, hi into
-    column i+j+1. Columns < 2^21; the *19 fold brings high columns back
-    with values < 2^26 — all safely inside int32.
+    Relaxed-weak inputs (limbs < 2^15 + 2^11): prod[i,j] = a_i * b_j
+    < 1.22e9 < 2^31. Split each product into 15-bit lo and hi < 2^16.2;
+    lo accumulates into column i+j, hi into column i+j+1. Column sums
+    < 17 * (2^15 + 2^16.2) < 2^21; the *19 fold brings high columns back
+    with values < 20 * 2^21 < 2^26 — all safely inside int32, matching
+    normalize()'s input bound.
+
+    Designed for op-count, not FLOPs: on TPU at PBFT batch sizes every
+    fused elementwise op costs ~the same wall time (latency floor), so
+    the column accumulation uses the 3-op skew reduction instead of 34
+    slice updates.
     """
     prod = a[..., :, None] * b[..., None, :]  # (..., 17, 17)
-    lo = prod & MASK
-    hi = prod >> RADIX
-    ncol = 2 * NLIMB  # 34 columns (index 33 = hi of i=j=16)
-    cols = jnp.zeros(a.shape[:-1] + (ncol,), dtype=DTYPE)
-    for i in range(NLIMB):
-        cols = cols.at[..., i : i + NLIMB].add(lo[..., i, :])
-        cols = cols.at[..., i + 1 : i + 1 + NLIMB].add(hi[..., i, :])
+    lo_cols = _antidiagonal_sums(prod & MASK)  # (..., 34); i+j <= 32
+    hi_cols = _antidiagonal_sums(prod >> RADIX)  # shift right to i+j+1
+    cols = lo_cols + jnp.pad(
+        hi_cols[..., :-1], [(0, 0)] * (hi_cols.ndim - 1) + [(1, 0)]
+    )
     # fold: column 17+t has weight 2^255 * 2^(15t) ≡ 19 * 2^(15t)
     out = cols[..., :NLIMB] + 19 * cols[..., NLIMB:]
     return normalize(out)

@@ -6,4 +6,4 @@ signature batch, sharded across an ICI-connected device mesh, with the
 quorum-certificate reduction expressed as an XLA collective (psum).
 """
 
-from .sharded_verify import make_quorum_step  # noqa: F401
+from .sharded_verify import make_comb_quorum_step, make_quorum_step  # noqa: F401

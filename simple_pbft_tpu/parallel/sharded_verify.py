@@ -38,6 +38,39 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from ..crypto.tpu_verifier import verify_kernel
+from ..ops import comb
+
+
+def make_comb_quorum_step(mesh: Mesh, axis: str = "dp"):
+    """Build the jitted SPMD step for the comb engine (the fast path).
+
+    Returns step(s_nib, k_nib, a_idx, a_tables, b_table, r_y, r_sign,
+                 precheck, inst_onehot) -> (verdict (B,) bool dp-sharded,
+                                            counts (n_inst,) replicated)
+
+    Per-item arrays shard over `axis`; the comb table banks replicate
+    (they are the committee's keys — small and read-only, so replication
+    costs HBM, not ICI). The quorum tally is the only cross-chip traffic:
+    one psum of an (n_instances,) int32 vector.
+    """
+    data = P(axis)
+    repl = P()
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(data, data, data, repl, repl, data, data, data, data),
+        out_specs=(data, repl),
+    )
+    def _step(s_nib, k_nib, a_idx, a_tables, b_table, r_y, r_sign, precheck, onehot):
+        verdict = comb.comb_verify_kernel(
+            s_nib, k_nib, a_idx, a_tables, b_table, r_y, r_sign, precheck
+        )
+        local = jnp.sum(onehot * verdict[:, None].astype(jnp.int32), axis=0)
+        counts = jax.lax.psum(local, axis)
+        return verdict, counts
+
+    return jax.jit(_step)
 
 
 def make_quorum_step(mesh: Mesh, axis: str = "dp"):

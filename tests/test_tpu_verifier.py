@@ -101,8 +101,63 @@ def test_empty_batch(verifier):
     assert verifier.verify_batch([]) == []
 
 
+def test_keybank_cap_falls_back_to_cpu():
+    """Keys beyond the bank cap must still verify correctly (CPU path),
+    and the bank must not grow past max_keys."""
+    from simple_pbft_tpu.crypto.tpu_verifier import KeyBank
+
+    v = TpuVerifier()
+    v._bank = KeyBank(initial_capacity=2, max_keys=2)
+    items = [_signed(i, b"cap %d" % i) for i in range(4)]  # 4 distinct keys
+    bad = bytearray(items[3].sig)
+    bad[2] ^= 4
+    items.append(BatchItem(items[3].pubkey, items[3].msg, bytes(bad)))
+    assert v.verify_batch(items) == [True, True, True, True, False]
+    assert len(v._bank._index) == 2
+
+
+def test_sharded_comb_quorum_step():
+    """Comb-engine shard_map verify + psum tally over the 8-device mesh."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from simple_pbft_tpu.ops import comb
+    from simple_pbft_tpu.crypto.tpu_verifier import KeyBank, prepare_comb_batch
+    from simple_pbft_tpu.parallel import make_comb_quorum_step
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("dp",))
+    n_inst = 2
+    items = [_signed(i % 8, b"inst vote %d" % i) for i in range(16)]
+    broken = bytearray(items[0].sig)
+    broken[3] ^= 1
+    items[0] = BatchItem(items[0].pubkey, items[0].msg, bytes(broken))
+
+    bank = KeyBank()
+    prep, _fallback = prepare_comb_batch(items, bank)
+    inst = np.arange(16, dtype=np.int32) % n_inst
+    onehot = np.eye(n_inst, dtype=np.int32)[inst]
+    data = NamedSharding(mesh, P("dp"))
+    repl = NamedSharding(mesh, P())
+    s_nib, k_nib, a_idx, r_y, r_sign, precheck = prep.arrays()
+    args = [
+        jax.device_put(s_nib, data),
+        jax.device_put(k_nib, data),
+        jax.device_put(a_idx, data),
+        jax.device_put(np.asarray(bank.device_tables()), repl),
+        jax.device_put(comb.base_table(), repl),
+        jax.device_put(r_y, data),
+        jax.device_put(r_sign, data),
+        jax.device_put(precheck, data),
+        jax.device_put(onehot, data),
+    ]
+    verdict, counts = make_comb_quorum_step(mesh)(*args)
+    verdict, counts = np.asarray(verdict), np.asarray(counts)
+    assert not verdict[0] and verdict[1:].all()
+    assert counts.tolist() == [7, 8]
+
+
 def test_sharded_quorum_step():
-    """shard_map verify + psum tally over the virtual 8-device mesh."""
+    """Ladder-engine shard_map verify + psum tally (fallback path)."""
     import jax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
