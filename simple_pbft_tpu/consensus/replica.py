@@ -53,6 +53,11 @@ from ..messages import (
 )
 from ..transport.base import Transport
 from .state import ExecuteBlock, Instance, SendCommit, SendPrepare
+from .viewchange import (
+    ViewChanger,
+    validate_new_view,
+    validate_view_change,
+)
 
 log = logging.getLogger("pbft.replica")
 
@@ -89,7 +94,9 @@ class Replica:
         self.client_watermark: Dict[str, int] = {}  # client -> max exec'd ts
         self.last_reply: Dict[str, Reply] = {}  # client -> latest reply
         self.committed_log: List[Tuple[int, str]] = []  # (seq, digest) > h
-        self.checkpoints: Dict[int, Dict[str, str]] = defaultdict(dict)
+        # seq -> sender -> signed Checkpoint message (kept, not just the
+        # digest: view-change certificates re-ship these as proof of h)
+        self.checkpoints: Dict[int, Dict[str, Checkpoint]] = defaultdict(dict)
         self.checkpoint_digests: Dict[int, str] = {}  # our own, by seq
         self.snapshots: Dict[int, str] = {}  # our app snapshots, by seq
         self.pending_sync: Optional[Tuple[int, str]] = None  # (seq, digest)
@@ -97,9 +104,10 @@ class Replica:
         self._replica_set = frozenset(cfg.replica_ids)
         self._running = False
         self._task: Optional[asyncio.Task] = None
-        # view-change machinery (wired by the viewchange module)
-        self.view_changes: Dict[int, Dict[str, ViewChange]] = defaultdict(dict)
-        self.view_change_timer: Optional[float] = None
+        # backup-side buffer of relayed-but-unexecuted client requests:
+        # the failover evidence, and the new primary's starting backlog
+        self.relay_buffer: Dict[Tuple[str, int], Request] = {}
+        self.vc = ViewChanger(self)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -115,12 +123,26 @@ class Replica:
 
     async def stop(self) -> None:
         self._running = False
+        self.vc.cancel()
         if self._task:
             self._task.cancel()
             try:
                 await self._task
             except asyncio.CancelledError:
                 pass
+
+    def has_outstanding_work(self) -> bool:
+        """Is there client work this replica is waiting on the committee
+        for? (The condition under which a stalled view must be abandoned.)"""
+        return bool(self.relay_buffer) or bool(self.pending_requests)
+
+    def adopt_relayed_requests(self) -> None:
+        """On becoming primary: everything relayed and still unexecuted
+        becomes our proposal backlog."""
+        for key, req in sorted(self.relay_buffer.items()):
+            if req.timestamp > self.client_watermark.get(req.client_id, 0):
+                self.pending_requests.append(req)
+        self.relay_buffer.clear()
 
     async def _run(self) -> None:
         while self._running:
@@ -216,6 +238,19 @@ class Replica:
                         sig=bytes.fromhex(req.sig),
                     )
                 )
+        elif isinstance(msg, ViewChange):
+            # nested checkpoint + prepared certificates verify in the batch
+            res = validate_view_change(self.cfg, msg, current_view_floor=0)
+            if res is None:
+                return []
+            msg._validated = res  # skip re-validation in on_view_change
+            items.extend(res[2])
+        elif isinstance(msg, NewView):
+            res = validate_new_view(self.cfg, msg)
+            if res is None:
+                return []
+            msg._validated = res
+            items.extend(res[1])
         return items
 
     def _validate_block(self, block) -> Optional[List[Request]]:
@@ -289,15 +324,22 @@ class Replica:
             cached = self.last_reply.get(req.client_id)
             if cached is not None and cached.timestamp == req.timestamp:
                 await self.transport.send(req.client_id, cached.to_wire())
+            elif key in self.relay_buffer or key in self.seen_requests:
+                # client is retrying something still unexecuted: the
+                # primary may be faulty — (re)arm the failover timer
+                self.vc.arm()
             return
         if self.is_primary:
             self.seen_requests[key] = 0  # 0 = queued, not yet assigned
             self.pending_requests.append(req)
+            self.vc.arm()
         else:
             # backup: relay to the primary (client may have broadcast after
-            # a timeout); the view-change timer for this request is armed by
-            # the viewchange module
+            # a timeout), remember it as failover evidence, arm the timer
             self.seen_requests[key] = 0
+            if len(self.relay_buffer) < 65536:  # bounded
+                self.relay_buffer[key] = req
+            self.vc.arm()
             await self.transport.send(
                 self.cfg.primary(self.view), req.to_wire()
             )
@@ -306,6 +348,8 @@ class Replica:
         """Primary: cut ALL pending requests into one block and propose.
         One proposal per sweep keeps pipelining (many seqs in flight)
         while batching whatever queued up since the last sweep."""
+        if self.vc.in_view_change:
+            return
         if not self.is_primary or not self.pending_requests:
             return
         if not self._in_window(self.next_seq):
@@ -335,6 +379,12 @@ class Replica:
     # ------------------------------------------------------------------
 
     async def _on_phase(self, msg) -> None:
+        if self.vc.in_view_change:
+            # between VIEW-CHANGE and NEW-VIEW a correct replica takes no
+            # part in the old view (Castro-Liskov); prepared state is
+            # already frozen into our VIEW-CHANGE certificate
+            self.metrics["dropped_in_viewchange"] += 1
+            return
         if msg.view != self.view:
             self.metrics["wrong_view"] += 1
             return
@@ -385,6 +435,7 @@ class Replica:
                 self.metrics["exec_bad_block"] += 1
                 continue
             for req in reqs:
+                self.relay_buffer.pop((req.client_id, req.timestamp), None)
                 if req.timestamp <= self.client_watermark.get(
                     req.client_id, 0
                 ):
@@ -406,6 +457,7 @@ class Replica:
                 await self.transport.send(req.client_id, reply.to_wire())
             if self.executed_seq % self.cfg.checkpoint_interval == 0:
                 await self._emit_checkpoint(self.executed_seq)
+            self.vc.reset()  # commits are progress: the primary is alive
 
     # ------------------------------------------------------------------
     # checkpoints / watermarks
@@ -448,15 +500,20 @@ class Replica:
     async def _on_checkpoint(self, msg: Checkpoint) -> None:
         if msg.seq <= self.stable_seq:
             return
-        self.checkpoints[msg.seq][msg.sender] = msg.state_digest
+        self.checkpoints[msg.seq][msg.sender] = msg
         votes = self.checkpoints[msg.seq]
         # stable when 2f+1 replicas certify the same digest at seq
         counts: Dict[str, int] = defaultdict(int)
-        for d in votes.values():
-            counts[d] += 1
+        for cp in votes.values():
+            counts[cp.state_digest] += 1
         digest, best = max(counts.items(), key=lambda kv: kv[1])
         if best >= self.cfg.quorum:
             await self._stabilize(msg.seq, digest)
+
+    async def on_checkpoint_msg(self, msg: Checkpoint) -> None:
+        """Public entry for signature-verified checkpoints arriving inside
+        view-change certificates (state catch-up across views)."""
+        await self._on_checkpoint(msg)
 
     async def _stabilize(self, seq: int, digest: str) -> None:
         """A checkpoint certificate formed at ``seq``. If we have executed
@@ -471,8 +528,8 @@ class Replica:
                 self.metrics["state_sync_requests"] += 1
                 certifiers = [
                     r
-                    for r, d in self.checkpoints[seq].items()
-                    if d == digest and r != self.id
+                    for r, cp in self.checkpoints[seq].items()
+                    if cp.state_digest == digest and r != self.id
                 ]
                 sr = StateRequest(seq=seq)
                 self.signer.sign_msg(sr)
@@ -541,8 +598,10 @@ class Replica:
         self.instances = {
             k: v for k, v in self.instances.items() if k[1] > seq
         }
+        # keep s == seq: the certificate AT the stable checkpoint is the
+        # checkpoint_proof every future VIEW-CHANGE must carry
         self.checkpoints = defaultdict(
-            dict, {s: v for s, v in self.checkpoints.items() if s > seq}
+            dict, {s: v for s, v in self.checkpoints.items() if s >= seq}
         )
         self.checkpoint_digests = {
             s: d for s, d in self.checkpoint_digests.items() if s >= seq
@@ -560,8 +619,20 @@ class Replica:
         }
 
     # ------------------------------------------------------------------
-    # view change (full protocol in consensus/viewchange.py; stub routes)
+    # view change (protocol in consensus/viewchange.py)
     # ------------------------------------------------------------------
 
     async def _on_view_message(self, msg) -> None:
-        self.metrics["view_msgs"] += 1  # handled by the viewchange module
+        self.metrics["view_msgs"] += 1
+        if isinstance(msg, ViewChange):
+            await self.vc.on_view_change(msg)
+        else:
+            await self.vc.on_new_view(msg)
+
+    async def on_phase_msg(self, msg) -> None:
+        """Public entry for the view-change installer's re-issued
+        pre-prepares."""
+        await self._on_phase(msg)
+
+    async def propose_if_ready(self) -> None:
+        await self._propose_if_ready()

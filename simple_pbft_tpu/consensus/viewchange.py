@@ -1,0 +1,476 @@
+"""View change: primary failover with VIEW-CHANGE / NEW-VIEW certificates.
+
+The reference never implemented this — its ``view.go`` is dead code
+(SURVEY.md §2 item 8: round-robin primary sketched, never called), and its
+author's notes (需要改进的地方.md:40-69) specify VIEW-CHANGE / NEW-VIEW as
+the largest missing piece. This module implements the Castro-Liskov
+protocol:
+
+- A backup with outstanding work arms a timer; on expiry it stops
+  participating in view v and broadcasts VIEW-CHANGE(v+1, h, C, P): its
+  stable checkpoint h, the 2f+1 checkpoint certificate C proving h, and a
+  prepared certificate P (pre-prepare + 2f+1 prepares) for every seq > h
+  it had prepared.
+- If a replica sees f+1 VIEW-CHANGEs for views above its own, it joins
+  the lowest such view immediately (liveness: don't wait for your own
+  timer once the committee is moving).
+- The new view's primary, on 2f+1 VIEW-CHANGEs, broadcasts
+  NEW-VIEW(v', V, O): the view-change certificate V and the re-issued
+  pre-prepares O — for every seq in (h, max_s] the highest-view prepared
+  certificate's block, or a no-op block for gaps. O is a deterministic
+  function of V, so backups recompute and cross-check it.
+- Timers back off exponentially (timeout doubles per failed view) so
+  consecutive crashed primaries are skipped in bounded time.
+
+TPU-first consequence: certificates are *batches of signatures* — one
+NEW-VIEW carries 2f+1 VIEW-CHANGEs, each holding up to W prepared proofs
+of 2f+2 signatures. The replica runtime flattens every nested signature
+into the same ``verify_batch`` call as regular traffic, so validating a
+view-change storm is a single TPU pass per sweep (BASELINE.md config 5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..crypto.verifier import BatchItem
+from ..messages import (
+    Checkpoint,
+    Commit,
+    Message,
+    NewView,
+    PrePrepare,
+    Prepare,
+    Request,
+    ViewChange,
+)
+
+NOOP_BLOCK: List[Dict[str, Any]] = []
+
+
+# ---------------------------------------------------------------------------
+# Certificate structural validation + signature-item collection.
+#
+# These run BEFORE signature verification: they bound sizes, decode nested
+# messages, and emit the BatchItems whose verdicts decide admission. A None
+# return means structurally inadmissible (never raises on hostile input).
+# ---------------------------------------------------------------------------
+
+
+def _decode(d: Any, want: type) -> Optional[Message]:
+    if not isinstance(d, dict):
+        return None
+    try:
+        msg = Message.from_dict(d)
+    except ValueError:
+        return None
+    return msg if isinstance(msg, want) else None
+
+
+def _sig_item(cfg, msg: Message) -> Optional[BatchItem]:
+    pub = cfg.pubkey(msg.sender)
+    if pub is None or not msg.sig:
+        return None
+    try:
+        sig = bytes.fromhex(msg.sig)
+    except ValueError:
+        return None
+    return BatchItem(pubkey=pub, msg=msg.signing_payload(), sig=sig)
+
+
+def validate_prepared_proof(
+    cfg, proof: Any, min_seq: int, max_seq: int
+) -> Optional[Tuple[PrePrepare, List[Prepare], List[BatchItem]]]:
+    """One P-set entry: {pre_prepare, prepares[2f+1]} for one seq."""
+    if not isinstance(proof, dict):
+        return None
+    pp = _decode(proof.get("pre_prepare"), PrePrepare)
+    if pp is None or not (min_seq < pp.seq <= max_seq):
+        return None
+    if pp.sender != cfg.primary(pp.view):
+        return None
+    if PrePrepare.block_digest(pp.block) != pp.digest:
+        return None
+    raw_prepares = proof.get("prepares")
+    if not isinstance(raw_prepares, list) or len(raw_prepares) > cfg.n:
+        return None
+    items: List[BatchItem] = []
+    it = _sig_item(cfg, pp)
+    if it is None:
+        return None
+    items.append(it)
+    prepares: List[Prepare] = []
+    senders = set()
+    for rd in raw_prepares:
+        p = _decode(rd, Prepare)
+        if p is None or p.sender in senders or p.sender not in cfg.replica_ids:
+            return None
+        if (p.view, p.seq, p.digest) != (pp.view, pp.seq, pp.digest):
+            return None
+        senders.add(p.sender)
+        it = _sig_item(cfg, p)
+        if it is None:
+            return None
+        items.append(it)
+        prepares.append(p)
+    if len(prepares) < cfg.quorum:
+        return None
+    return pp, prepares, items
+
+
+def validate_view_change(
+    cfg, msg: ViewChange, current_view_floor: int = 0
+) -> Optional[Tuple[Dict[int, Tuple[PrePrepare, List[Prepare]]], List[Checkpoint], List[BatchItem]]]:
+    """Structural check of one VIEW-CHANGE; returns (prepared-by-seq,
+    checkpoint proof msgs, nested sig items) or None."""
+    if msg.sender not in cfg.replica_ids:
+        return None
+    if msg.new_view <= current_view_floor:
+        return None
+    if msg.stable_seq < 0:
+        return None
+    items: List[BatchItem] = []
+    # checkpoint certificate for h (h = 0 needs no proof: genesis)
+    cps: List[Checkpoint] = []
+    if msg.stable_seq > 0:
+        if not isinstance(msg.checkpoint_proof, list) or len(msg.checkpoint_proof) > cfg.n:
+            return None
+        senders = set()
+        digests = set()
+        for rd in msg.checkpoint_proof:
+            cp = _decode(rd, Checkpoint)
+            if cp is None or cp.seq != msg.stable_seq:
+                return None
+            if cp.sender in senders or cp.sender not in cfg.replica_ids:
+                return None
+            senders.add(cp.sender)
+            digests.add(cp.state_digest)
+            it = _sig_item(cfg, cp)
+            if it is None:
+                return None
+            items.append(it)
+            cps.append(cp)
+        if len(cps) < cfg.quorum or len(digests) != 1:
+            return None
+    if not isinstance(msg.prepared_proofs, list):
+        return None
+    if len(msg.prepared_proofs) > cfg.watermark_window:
+        return None
+    prepared: Dict[int, Tuple[PrePrepare, List[Prepare]]] = {}
+    for proof in msg.prepared_proofs:
+        res = validate_prepared_proof(
+            cfg, proof, msg.stable_seq, msg.stable_seq + cfg.watermark_window
+        )
+        if res is None:
+            return None
+        pp, prepares, pitems = res
+        if pp.seq in prepared or pp.view >= msg.new_view:
+            return None
+        prepared[pp.seq] = (pp, prepares)
+        items.extend(pitems)
+    return prepared, cps, items
+
+
+def compute_o_set(
+    cfg, vcs: Dict[str, ViewChange], new_view: int
+) -> Tuple[int, List[Tuple[int, str, List[Dict[str, Any]]]]]:
+    """Deterministic O-set from a view-change certificate: returns
+    (h, [(seq, digest, block), ...]) for seq in (h, max_s], highest-view
+    prepared certificate winning, no-op blocks for gaps.
+
+    Callers pass only structurally-validated, signature-verified VCs.
+    """
+    h = max((vc.stable_seq for vc in vcs.values()), default=0)
+    best: Dict[int, Tuple[int, str, List[Dict[str, Any]]]] = {}
+    for vc in vcs.values():
+        for proof in vc.prepared_proofs:
+            pp = _decode(proof.get("pre_prepare"), PrePrepare)
+            if pp is None or pp.seq <= h:
+                continue
+            cur = best.get(pp.seq)
+            if cur is None or pp.view > cur[0]:
+                best[pp.seq] = (pp.view, pp.digest, pp.block)
+    max_s = max(best, default=h)
+    out = []
+    for seq in range(h + 1, max_s + 1):
+        if seq in best:
+            _, digest, block = best[seq]
+            out.append((seq, digest, block))
+        else:
+            out.append((seq, PrePrepare.block_digest(NOOP_BLOCK), NOOP_BLOCK))
+    return h, out
+
+
+def validate_new_view(
+    cfg, msg: NewView
+) -> Optional[Tuple[Dict[str, ViewChange], List[BatchItem]]]:
+    """Structural check of NEW-VIEW: the 2f+1 VC certificate plus the
+    re-issued pre-prepares, which must equal the recomputed O-set."""
+    if msg.sender != cfg.primary(msg.new_view):
+        return None
+    if not isinstance(msg.viewchange_proof, list) or len(msg.viewchange_proof) > cfg.n:
+        return None
+    vcs: Dict[str, ViewChange] = {}
+    items: List[BatchItem] = []
+    for rd in msg.viewchange_proof:
+        vc = _decode(rd, ViewChange)
+        if vc is None or vc.new_view != msg.new_view or vc.sender in vcs:
+            return None
+        res = validate_view_change(cfg, vc)
+        if res is None:
+            return None
+        _, _, vitems = res
+        it = _sig_item(cfg, vc)
+        if it is None:
+            return None
+        items.append(it)
+        items.extend(vitems)
+        vcs[vc.sender] = vc
+    if len(vcs) < cfg.quorum:
+        return None
+    # O must be exactly the deterministic function of V
+    _, o_set = compute_o_set(cfg, vcs, msg.new_view)
+    if not isinstance(msg.pre_prepares, list) or len(msg.pre_prepares) != len(o_set):
+        return None
+    for rd, (seq, digest, block) in zip(msg.pre_prepares, o_set):
+        pp = _decode(rd, PrePrepare)
+        if pp is None:
+            return None
+        if (pp.view, pp.seq, pp.digest) != (msg.new_view, seq, digest):
+            return None
+        if pp.block != block or pp.sender != msg.sender:
+            return None
+        it = _sig_item(cfg, pp)
+        if it is None:
+            return None
+        items.append(it)
+        # client signatures inside re-issued blocks verify too
+        for rdreq in pp.block:
+            req = _decode(rdreq, Request)
+            if req is None or req.sender != req.client_id:
+                return None
+            it = _sig_item(cfg, req)
+            if it is None:
+                return None
+            items.append(it)
+    return vcs, items
+
+
+# ---------------------------------------------------------------------------
+# Runtime side: timers + protocol driver, owned by a Replica
+# ---------------------------------------------------------------------------
+
+
+class ViewChanger:
+    """Per-replica view-change state machine.
+
+    Owns the failover timer and the VIEW-CHANGE/NEW-VIEW exchange; calls
+    back into the replica for transport, signing, and instance adoption.
+    """
+
+    # bound on how far ahead of the current view VIEW-CHANGEs are tracked
+    # (honest backoff walks one view at a time; anything further is a
+    # Byzantine memory-growth vector)
+    MAX_VIEWS_AHEAD = 128
+
+    def __init__(self, replica) -> None:
+        self.r = replica
+        self.in_view_change = False
+        self.target_view = replica.view
+        self.vc_store: Dict[int, Dict[str, ViewChange]] = {}
+        self.new_view_sent: set = set()
+        self._timer: Optional[asyncio.TimerHandle] = None
+        self._vc_task: Optional[asyncio.Task] = None
+        self._timeout = replica.cfg.view_timeout
+
+    # -- timers ---------------------------------------------------------
+
+    def arm(self) -> None:
+        """Arm the failover timer if not already armed (called whenever a
+        request is outstanding)."""
+        if self._timer is None and self.r.cfg.view_timeout > 0:
+            loop = asyncio.get_running_loop()
+            self._timer = loop.call_later(self._timeout, self._expired)
+
+    def reset(self) -> None:
+        """Progress was made: disarm, re-arm if work remains."""
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        self._timeout = self.r.cfg.view_timeout  # progress resets backoff
+        if self.r.has_outstanding_work():
+            self.arm()
+
+    def cancel(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def _expired(self) -> None:
+        self._timer = None
+        if not self.r.has_outstanding_work():
+            return
+        # retain the task: a bare ensure_future is only weakly referenced
+        # by the loop and can be collected mid-broadcast
+        self._vc_task = asyncio.ensure_future(
+            self.start_view_change(max(self.target_view, self.r.view) + 1)
+        )
+        self._vc_task.add_done_callback(lambda _t: setattr(self, "_vc_task", None))
+
+    # -- initiating -----------------------------------------------------
+
+    async def start_view_change(self, new_view: int) -> None:
+        """Stop participating in the current view, broadcast VIEW-CHANGE."""
+        if new_view <= self.target_view and self.in_view_change:
+            return
+        if new_view <= self.r.view:
+            return
+        self.in_view_change = True
+        self.target_view = new_view
+        self.r.metrics["view_changes_started"] += 1
+        # exponential backoff: if this view change stalls, suspect further
+        self._timeout = min(self._timeout * 2, 60.0)
+        if self.r.cfg.view_timeout > 0:
+            loop = asyncio.get_running_loop()
+            self.cancel()
+            self._timer = loop.call_later(self._timeout, self._expired)
+
+        vc = self.build_view_change(new_view)
+        self.r.signer.sign_msg(vc)
+        await self.r.transport.broadcast(vc.to_wire(), self.r.cfg.replica_ids)
+        await self.on_view_change(vc)  # count our own
+
+    def build_view_change(self, new_view: int) -> ViewChange:
+        r = self.r
+        cp_proof = []
+        if r.stable_seq > 0:
+            cert = r.checkpoints.get(r.stable_seq, {})
+            cp_proof = [cp.to_dict() for cp in cert.values()][: r.cfg.n]
+        proofs = []
+        for (view, seq), inst in sorted(r.instances.items()):
+            if seq <= r.stable_seq or view >= new_view:
+                continue
+            proof = inst.prepared_proof()
+            if proof is not None:
+                proofs.append(proof)
+        return ViewChange(
+            new_view=new_view,
+            stable_seq=r.stable_seq,
+            checkpoint_proof=cp_proof,
+            prepared_proofs=proofs,
+        )
+
+    # -- receiving ------------------------------------------------------
+
+    async def on_view_change(self, msg: ViewChange) -> None:
+        """Signature-verified VIEW-CHANGE arrives (own or peer's)."""
+        r = self.r
+        if msg.new_view <= r.view:
+            return
+        if msg.new_view > r.view + self.MAX_VIEWS_AHEAD:
+            r.metrics["viewchange_too_far"] += 1
+            return
+        res = getattr(msg, "_validated", None)
+        if res is None:
+            res = validate_view_change(r.cfg, msg, current_view_floor=r.view)
+        if res is None:
+            r.metrics["bad_viewchange"] += 1
+            return
+        store = self.vc_store.setdefault(msg.new_view, {})
+        store[msg.sender] = msg
+        # adopt the highest checkpoint the committee proves (state catch-up)
+        _, cps, _ = res
+        for cp in cps:
+            await r.on_checkpoint_msg(cp)
+
+        # liveness: f+1 replicas moving past us -> join the lowest such view
+        if not self.in_view_change or msg.new_view > self.target_view:
+            above = [
+                v
+                for v, senders in self.vc_store.items()
+                if v > r.view and len(senders) >= r.cfg.weak_quorum
+            ]
+            if above:
+                lowest = min(above)
+                if not (self.in_view_change and self.target_view >= lowest):
+                    await self.start_view_change(lowest)
+
+        # new primary: certificate complete -> NEW-VIEW
+        if (
+            r.cfg.primary(msg.new_view) == r.id
+            and len(store) >= r.cfg.quorum
+            and msg.new_view not in self.new_view_sent
+        ):
+            await self._send_new_view(msg.new_view)
+
+    async def _send_new_view(self, new_view: int) -> None:
+        r = self.r
+        vcs = dict(list(self.vc_store[new_view].items())[: r.cfg.quorum])
+        h, o_set = compute_o_set(r.cfg, vcs, new_view)
+        pre_prepares = []
+        for seq, digest, block in o_set:
+            pp = PrePrepare(view=new_view, seq=seq, digest=digest, block=block)
+            r.signer.sign_msg(pp)
+            pre_prepares.append(pp.to_dict())
+        nv = NewView(
+            new_view=new_view,
+            viewchange_proof=[vc.to_dict() for vc in vcs.values()],
+            pre_prepares=pre_prepares,
+        )
+        r.signer.sign_msg(nv)
+        self.new_view_sent.add(new_view)
+        r.metrics["new_views_sent"] += 1
+        await r.transport.broadcast(nv.to_wire(), r.cfg.replica_ids)
+        await self.on_new_view(nv)  # install locally
+
+    async def on_new_view(self, msg: NewView) -> None:
+        """Signature-verified NEW-VIEW arrives: validate and install."""
+        r = self.r
+        if msg.new_view <= r.view:
+            return
+        if self.in_view_change and msg.new_view < self.target_view:
+            # we already promised a later view — our outstanding
+            # VIEW-CHANGE freezes prepared state for target_view; rejoining
+            # an earlier view could let decisions made there escape a
+            # future NEW-VIEW(target) certificate (safety)
+            r.metrics["newview_below_target"] += 1
+            return
+        res = getattr(msg, "_validated", None)
+        if res is None:
+            res = validate_new_view(r.cfg, msg)
+        if res is None:
+            r.metrics["bad_newview"] += 1
+            return
+        vcs, _ = res
+        h, o_set = compute_o_set(r.cfg, vcs, msg.new_view)
+        # catch up on checkpoints the certificate proves
+        for vc in vcs.values():
+            for rd in vc.checkpoint_proof:
+                cp = _decode(rd, Checkpoint)
+                if cp is not None:
+                    await r.on_checkpoint_msg(cp)
+        await self.install(msg.new_view, msg)
+
+    async def install(self, new_view: int, nv: NewView) -> None:
+        """Adopt the new view and replay its re-issued pre-prepares."""
+        r = self.r
+        r.view = new_view
+        self.in_view_change = False
+        self.target_view = new_view
+        self.vc_store = {v: s for v, s in self.vc_store.items() if v > new_view}
+        self._timeout = r.cfg.view_timeout
+        self.reset()
+        r.metrics["views_installed"] += 1
+
+        max_seq = r.stable_seq
+        for rd in nv.pre_prepares:
+            pp = _decode(rd, PrePrepare)
+            if pp is None:  # validated already; defensive
+                continue
+            max_seq = max(max_seq, pp.seq)
+            await r.on_phase_msg(pp)
+        if r.cfg.primary(new_view) == r.id:
+            r.next_seq = max_seq + 1
+            r.adopt_relayed_requests()
+        await r.propose_if_ready()

@@ -1,0 +1,293 @@
+"""View-change protocol: failover, certificates, O-set determinism.
+
+The reference's view change is dead code (view.go, SURVEY.md §2 item 8);
+these tests cover the full Castro-Liskov protocol this framework adds:
+timer-driven failover, VIEW-CHANGE/NEW-VIEW certificate validation, the
+f+1 join rule, prepared-state carryover, and adversarial certificates.
+"""
+
+import asyncio
+
+import pytest
+
+from simple_pbft_tpu.committee import LocalCommittee
+from simple_pbft_tpu.config import make_test_committee
+from simple_pbft_tpu.consensus.viewchange import (
+    compute_o_set,
+    validate_new_view,
+    validate_view_change,
+)
+from simple_pbft_tpu.crypto.signer import Signer
+from simple_pbft_tpu.messages import (
+    Checkpoint,
+    NewView,
+    PrePrepare,
+    Prepare,
+    Request,
+    ViewChange,
+)
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+async def _eventually(pred, timeout=10.0, tick=0.02):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(tick)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# End-to-end failover
+# ---------------------------------------------------------------------------
+
+
+def test_failover_after_primary_crash():
+    """Primary dies; client work drives a view change; the request still
+    executes under the new primary, on every surviving replica."""
+
+    async def main():
+        c = LocalCommittee.build(n=4, view_timeout=0.3)
+        c.start()
+        client = c.clients[0]
+        client.request_timeout = 0.25
+        assert await client.submit("put a 1") == "ok"
+
+        # kill the view-0 primary
+        await c.replica("r0").stop()
+        result = await client.submit("put b 2", retries=20)
+        assert result == "ok"
+        survivors = [r for r in c.replicas if r.id != "r0"]
+        assert all(r.view >= 1 for r in survivors)
+        assert await _eventually(
+            lambda: all(
+                r.app.data.get("b") == "2" for r in survivors
+            )
+        )
+        # the committee keeps working in the new view
+        assert await client.submit("get a", retries=20) == "1"
+        await c.stop()
+
+    _run(main())
+
+
+def test_failover_after_stable_checkpoint():
+    """Regression: a VIEW-CHANGE built after h > 0 must carry the 2f+1
+    checkpoint certificate AT h (GC once deleted it, wedging failover)."""
+
+    async def main():
+        c = LocalCommittee.build(n=4, view_timeout=0.3, checkpoint_interval=2)
+        c.start()
+        client = c.clients[0]
+        client.request_timeout = 0.25
+        for i in range(4):  # past two checkpoint intervals
+            assert await client.submit(f"put k{i} {i}") == "ok"
+        assert all(r.stable_seq > 0 for r in c.replicas)
+        await c.replica("r0").stop()
+        assert await client.submit("put after 1", retries=20) == "ok"
+        survivors = [r for r in c.replicas if r.id != "r0"]
+        assert all(r.view >= 1 for r in survivors)
+        assert all(r.app.data.get("after") == "1" for r in survivors)
+        await c.stop()
+
+    _run(main())
+
+
+def test_cascaded_failover_two_primaries_down():
+    """Views 0 and 1's primaries both dead: exponential backoff walks to
+    view 2 and the committee (n=7, f=2) commits there."""
+
+    async def main():
+        c = LocalCommittee.build(n=7, view_timeout=0.25)
+        c.start()
+        client = c.clients[0]
+        client.request_timeout = 0.25
+        await c.replica("r0").stop()
+        await c.replica("r1").stop()
+        assert await client.submit("put x 9", retries=40) == "ok"
+        survivors = [r for r in c.replicas if r.id not in ("r0", "r1")]
+        assert all(r.view >= 2 for r in survivors)
+        await c.stop()
+
+    _run(main())
+
+
+def test_prepared_request_survives_view_change():
+    """A block prepared in view 0 but not committed (commits partitioned)
+    must re-commit in view 1 with the same digest — no lost or forked
+    decisions across the failover."""
+
+    async def main():
+        from simple_pbft_tpu.transport.local import FaultPlan
+
+        plan = FaultPlan()
+        c = LocalCommittee.build(n=4, view_timeout=0.4, fault_plan=plan)
+        c.start()
+        client = c.clients[0]
+        client.request_timeout = 0.3
+        assert await client.submit("put seed 1") == "ok"
+
+        # cut the primary off from everyone (it can still receive) right
+        # after its proposal wave: replicas prepare, commits can't quorum
+        # at the client... simpler: cut commits by partitioning r0 fully
+        # after a short delay — the request below will prepare via r0's
+        # pre-prepare, then stall, then view-change.
+        async def cut_soon():
+            await asyncio.sleep(0.05)
+            for peer in ("r1", "r2", "r3", "c0"):
+                plan.cut("r0", peer)
+
+        asyncio.get_running_loop().create_task(cut_soon())
+        result = await client.submit("put y 7", retries=30)
+        assert result == "ok"
+        survivors = [c.replica(r) for r in ("r1", "r2", "r3")]
+        assert all(r.app.data.get("y") == "7" for r in survivors)
+        snaps = {r.app.snapshot() for r in survivors}
+        assert len(snaps) == 1  # no divergence
+        await c.stop()
+
+    _run(main())
+
+
+# ---------------------------------------------------------------------------
+# Certificate-level units
+# ---------------------------------------------------------------------------
+
+
+def _signed_vc(cfg, keys, sender, new_view, stable_seq=0, proofs=None, cps=None):
+    vc = ViewChange(
+        new_view=new_view,
+        stable_seq=stable_seq,
+        checkpoint_proof=cps or [],
+        prepared_proofs=proofs or [],
+    )
+    Signer(sender, keys[sender].seed).sign_msg(vc)
+    return vc
+
+
+def _prepared_proof(cfg, keys, view, seq, op="noop"):
+    req = Request(client_id="c0", timestamp=seq, operation=op)
+    Signer("c0", keys["c0"].seed).sign_msg(req)
+    block = [req.to_dict()]
+    pp = PrePrepare(
+        view=view, seq=seq, digest=PrePrepare.block_digest(block), block=block
+    )
+    Signer(cfg.primary(view), keys[cfg.primary(view)].seed).sign_msg(pp)
+    prepares = []
+    for rid in cfg.replica_ids[: cfg.quorum]:
+        p = Prepare(view=view, seq=seq, digest=pp.digest)
+        Signer(rid, keys[rid].seed).sign_msg(p)
+        prepares.append(p.to_dict())
+    return {"pre_prepare": pp.to_dict(), "prepares": prepares}, pp
+
+
+def test_o_set_prefers_highest_view_and_fills_gaps():
+    cfg, keys = make_test_committee(n=4)
+    proof_v0, pp0 = _prepared_proof(cfg, keys, view=0, seq=2, op="old")
+    proof_v1, pp1 = _prepared_proof(cfg, keys, view=1, seq=2, op="new")
+    vcs = {
+        "r1": _signed_vc(cfg, keys, "r1", 2, proofs=[proof_v0]),
+        "r2": _signed_vc(cfg, keys, "r2", 2, proofs=[proof_v1]),
+        "r3": _signed_vc(cfg, keys, "r3", 2),
+    }
+    h, o_set = compute_o_set(cfg, vcs, new_view=2)
+    assert h == 0
+    assert [seq for seq, _, _ in o_set] == [1, 2]
+    # seq 1 is a gap -> no-op block; seq 2 takes the view-1 certificate
+    assert o_set[0][2] == []
+    assert o_set[1][1] == pp1.digest
+
+
+def test_validate_view_change_rejects_bad_certs():
+    cfg, keys = make_test_committee(n=4)
+    proof, _ = _prepared_proof(cfg, keys, view=0, seq=1)
+
+    good = _signed_vc(cfg, keys, "r1", 1, proofs=[proof])
+    assert validate_view_change(cfg, good) is not None
+
+    # under-sized prepare certificate
+    thin = {
+        "pre_prepare": proof["pre_prepare"],
+        "prepares": proof["prepares"][:1],
+    }
+    assert (
+        validate_view_change(cfg, _signed_vc(cfg, keys, "r1", 1, proofs=[thin]))
+        is None
+    )
+
+    # prepared proof from a view >= the target view is inadmissible
+    future_proof, _ = _prepared_proof(cfg, keys, view=1, seq=1)
+    assert (
+        validate_view_change(
+            cfg, _signed_vc(cfg, keys, "r1", 1, proofs=[future_proof])
+        )
+        is None
+    )
+
+    # stable_seq > 0 demands a checkpoint certificate
+    assert (
+        validate_view_change(cfg, _signed_vc(cfg, keys, "r1", 1, stable_seq=64))
+        is None
+    )
+
+    # non-committee sender
+    outsider = ViewChange(new_view=1)
+    outsider.sender = "mallory"
+    assert validate_view_change(cfg, outsider) is None
+
+
+def test_validate_new_view_rejects_tampered_o_set():
+    cfg, keys = make_test_committee(n=4)
+    proof, pp = _prepared_proof(cfg, keys, view=0, seq=1, op="put k v")
+    vcs = [
+        _signed_vc(cfg, keys, rid, 1, proofs=[proof] if rid == "r1" else [])
+        for rid in ("r1", "r2", "r3")
+    ]
+    new_primary = cfg.primary(1)
+
+    def build_nv(blocks):
+        pps = []
+        for seq, digest, block in blocks:
+            npp = PrePrepare(view=1, seq=seq, digest=digest, block=block)
+            Signer(new_primary, keys[new_primary].seed).sign_msg(npp)
+            pps.append(npp.to_dict())
+        nv = NewView(
+            new_view=1,
+            viewchange_proof=[v.to_dict() for v in vcs],
+            pre_prepares=pps,
+        )
+        Signer(new_primary, keys[new_primary].seed).sign_msg(nv)
+        return nv
+
+    _, o_set = compute_o_set(cfg, {v.sender: v for v in vcs}, 1)
+    assert validate_new_view(cfg, build_nv(o_set)) is not None
+
+    # drop the prepared slot (primary trying to lose a prepared request)
+    empty = [(1, PrePrepare.block_digest([]), [])]
+    assert validate_new_view(cfg, build_nv(empty)) is None
+
+    # wrong sender: only the new view's primary may install it
+    nv = build_nv(o_set)
+    imposter = "r2" if new_primary != "r2" else "r3"
+    nv.sender = ""
+    Signer(imposter, keys[imposter].seed).sign_msg(nv)
+    assert validate_new_view(cfg, nv) is None
+
+
+def test_checkpoint_proof_carries_watermark():
+    """A VC claiming h > 0 with a valid 2f+1 checkpoint cert validates."""
+    cfg, keys = make_test_committee(n=4)
+    cps = []
+    for rid in cfg.replica_ids[: cfg.quorum]:
+        cp = Checkpoint(seq=64, state_digest="d" * 64)
+        Signer(rid, keys[rid].seed).sign_msg(cp)
+        cps.append(cp.to_dict())
+    vc = _signed_vc(cfg, keys, "r1", 1, stable_seq=64, cps=cps)
+    res = validate_view_change(cfg, vc)
+    assert res is not None
+    _, cp_msgs, items = res
+    assert len(cp_msgs) == 3 and len(items) == 3
