@@ -1,0 +1,93 @@
+"""Replica node binary: ``python -m simple_pbft_tpu.node``.
+
+Parity target: the reference's pbftNode.go (flags -id/-log, one process
+per replica, blocking serve). Here: deployment document instead of a
+hard-coded table, pluggable verifier backend, structured logging, clean
+shutdown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+
+from . import deploy
+from .consensus.replica import Replica
+from .crypto.verifier import CpuVerifier, InsecureVerifier, best_cpu_verifier
+from .transport.tcp import TcpTransport
+
+
+def make_verifier(name: str):
+    if name == "tpu":
+        from .crypto.tpu_verifier import TpuVerifier
+
+        return TpuVerifier()
+    if name == "cpu":
+        return best_cpu_verifier()
+    if name == "cpu-pure":
+        return CpuVerifier()
+    if name == "insecure":
+        return InsecureVerifier()
+    raise SystemExit(f"unknown verifier backend: {name}")
+
+
+async def run_node(args) -> None:
+    dep = deploy.load(os.path.join(args.deploy_dir, "committee.json"))
+    seed = deploy.read_seed(args.deploy_dir, args.id)
+    transport = TcpTransport(
+        node_id=args.id,
+        listen_addr=dep.addr(args.id),
+        peers=dep.peers_for(args.id),
+    )
+    await transport.start()
+    replica = Replica(
+        node_id=args.id,
+        cfg=dep.cfg,
+        seed=seed,
+        transport=transport,
+        verifier=make_verifier(args.verifier),
+    )
+    replica.start()
+    logging.info(
+        "%s listening on %s (verifier=%s, n=%d, f=%d)",
+        args.id, dep.addr(args.id), args.verifier, dep.cfg.n, dep.cfg.f,
+    )
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await replica.stop()
+    await transport.stop()
+    logging.info("%s: metrics %s", args.id, dict(replica.metrics))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="simple_pbft_tpu replica node")
+    ap.add_argument("--id", required=True, help="replica id (e.g. r0)")
+    ap.add_argument(
+        "--deploy-dir",
+        required=True,
+        help="directory holding committee.json and <id>.seed",
+    )
+    ap.add_argument(
+        "--verifier",
+        default="cpu",
+        choices=["cpu", "cpu-pure", "tpu", "insecure"],
+        help="signature verification backend",
+    )
+    ap.add_argument("--log-level", default="INFO")
+    args = ap.parse_args()
+    logging.basicConfig(
+        level=args.log_level,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    asyncio.run(run_node(args))
+
+
+if __name__ == "__main__":
+    main()
