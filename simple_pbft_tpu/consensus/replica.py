@@ -107,6 +107,9 @@ class Replica:
         # backup-side buffer of relayed-but-unexecuted client requests:
         # the failover evidence, and the new primary's starting backlog
         self.relay_buffer: Dict[Tuple[str, int], Request] = {}
+        # NEW-VIEW pre-prepares beyond our lagging watermark window,
+        # replayed after state transfer advances stable_seq
+        self.vc_replay: Dict[int, PrePrepare] = {}
         self.vc = ViewChanger(self)
 
     # ------------------------------------------------------------------
@@ -417,6 +420,12 @@ class Replica:
             await self.transport.broadcast(vote.to_wire(), self.cfg.replica_ids)
             await self._on_phase(vote)
         elif isinstance(act, ExecuteBlock):
+            if act.seq <= self.executed_seq:
+                # a re-issued pre-prepare for an already-executed seq
+                # (possible after view install when executed_seq > stable
+                # at the cert's h) must not park a stale entry in `ready`
+                self.metrics["stale_execute_dropped"] += 1
+                return
             self.ready[act.seq] = act
             await self._execute_ready()
 
@@ -537,6 +546,7 @@ class Replica:
                     await self.transport.send(peer, sr.to_wire())
             return
         self._advance_stable(seq)
+        await self._replay_vc_buffer()
 
     async def _on_state_request(self, msg: StateRequest) -> None:
         snap = self.snapshots.get(msg.seq)
@@ -586,6 +596,7 @@ class Replica:
         self.metrics["state_syncs"] += 1
         self._advance_stable(seq)
         await self._execute_ready()  # buffered blocks beyond the snapshot
+        await self._replay_vc_buffer()
 
     def _advance_stable(self, seq: int) -> None:
         if seq <= self.stable_seq:
@@ -612,11 +623,27 @@ class Replica:
         self.committed_log = [
             (s, d) for (s, d) in self.committed_log if s > seq
         ]
+        self.ready = {s: a for s, a in self.ready.items() if s > seq}
+        self.vc_replay = {
+            s: pp for s, pp in self.vc_replay.items() if s > seq
+        }
         self.seen_requests = {
             (c, ts): assigned
             for (c, ts), assigned in self.seen_requests.items()
             if ts > self.client_watermark.get(c, 0)
         }
+
+    async def _replay_vc_buffer(self) -> None:
+        """Feed buffered NEW-VIEW pre-prepares (seqs that were beyond our
+        lagging window at install time) now that the window has advanced."""
+        for s in sorted(self.vc_replay):
+            pp = self.vc_replay[s]
+            if pp.view != self.view:
+                del self.vc_replay[s]  # superseded by a later view change
+                continue
+            if self._in_window(s):
+                del self.vc_replay[s]
+                await self._on_phase(pp)
 
     # ------------------------------------------------------------------
     # view change (protocol in consensus/viewchange.py)

@@ -32,6 +32,7 @@ view-change storm is a single TPU pass per sweep (BASELINE.md config 5).
 from __future__ import annotations
 
 import asyncio
+import logging
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..crypto.verifier import BatchItem
@@ -45,6 +46,8 @@ from ..messages import (
     Request,
     ViewChange,
 )
+
+log = logging.getLogger("pbft.viewchange")
 
 NOOP_BLOCK: List[Dict[str, Any]] = []
 
@@ -338,7 +341,20 @@ class ViewChanger:
 
         vc = self.build_view_change(new_view)
         self.r.signer.sign_msg(vc)
-        await self.r.transport.broadcast(vc.to_wire(), self.r.cfg.replica_ids)
+        wire = vc.to_wire()
+        # Size guard: prepared proofs embed whole request blocks, so a full
+        # window of full batches can exceed the certificate wire cap — the
+        # message would be undeliverable exactly when a loaded primary
+        # fails. Surface it loudly; the roadmap fix is digest-only P-set
+        # entries with on-demand block fetch.
+        if len(wire) > ViewChange.MAX_WIRE_BYTES:
+            self.r.metrics["viewchange_oversized"] += 1
+            log.error(
+                "%s: VIEW-CHANGE(%d) exceeds wire cap (%d proofs); "
+                "reduce max_batch/watermark_window",
+                self.r.id, new_view, len(vc.prepared_proofs),
+            )
+        await self.r.transport.broadcast(wire, self.r.cfg.replica_ids)
         await self.on_view_change(vc)  # count our own
 
     def build_view_change(self, new_view: int) -> ViewChange:
@@ -347,13 +363,21 @@ class ViewChanger:
         if r.stable_seq > 0:
             cert = r.checkpoints.get(r.stable_seq, {})
             cp_proof = [cp.to_dict() for cp in cert.values()][: r.cfg.n]
-        proofs = []
+        # Castro-Liskov P-set: ONE certificate per seq — the highest-view
+        # one. A seq prepared in two successive views (prepared in v,
+        # re-prepared via the O-set in v+1, not committed) must not emit
+        # duplicate-seq proofs: validate_view_change rejects those, which
+        # would silence this replica in every future failover.
+        best: Dict[int, Tuple[int, Dict[str, Any]]] = {}
         for (view, seq), inst in sorted(r.instances.items()):
             if seq <= r.stable_seq or view >= new_view:
                 continue
             proof = inst.prepared_proof()
             if proof is not None:
-                proofs.append(proof)
+                cur = best.get(seq)
+                if cur is None or view > cur[0]:
+                    best[seq] = (view, proof)
+        proofs = [best[seq][1] for seq in sorted(best)]
         return ViewChange(
             new_view=new_view,
             stable_seq=r.stable_seq,
@@ -469,7 +493,14 @@ class ViewChanger:
             if pp is None:  # validated already; defensive
                 continue
             max_seq = max(max_seq, pp.seq)
-            await r.on_phase_msg(pp)
+            if pp.seq > r.stable_seq + r.cfg.watermark_window:
+                # local watermark lags the certificate's h (state transfer
+                # pending): _on_phase would silently drop this seq and we'd
+                # never participate in the slot. Buffer; the replica
+                # replays once _advance_stable catches up.
+                r.vc_replay[pp.seq] = pp
+            else:
+                await r.on_phase_msg(pp)
         if r.cfg.primary(new_view) == r.id:
             r.next_seq = max_seq + 1
             r.adopt_relayed_requests()

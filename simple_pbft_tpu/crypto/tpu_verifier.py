@@ -375,6 +375,15 @@ class TpuVerifier:
                     out_shardings=data,
                 )
             self._align = int(np.prod(mesh.devices.shape))
+            if self._align & (self._align - 1):
+                # batches pad to power-of-two BUCKETS (and the comb
+                # kernel's batch inversion needs a power of two); a
+                # non-power-of-two mesh cannot divide them evenly and the
+                # sharded jit would fail at runtime instead of here
+                raise ValueError(
+                    f"TpuVerifier needs a power-of-two mesh size, got "
+                    f"{self._align} devices"
+                )
         else:
             self._fn = jax.jit(
                 comb.comb_verify_kernel if mode == "comb" else verify_kernel

@@ -126,17 +126,39 @@ class Message:
             kw[f.name] = v
         return cls(**kw)
 
+    # Per-type wire cap. Data-plane messages stay small; view-change-class
+    # certificates (ViewChange/NewView) override with a larger cap because
+    # their prepared proofs embed whole request blocks — without the
+    # override a loaded primary's failover message would be undeliverable.
     MAX_WIRE_BYTES: ClassVar[int] = 8 * 1024 * 1024
+    # absolute pre-parse bound (the largest any subclass allows)
+    MAX_CERT_WIRE_BYTES: ClassVar[int] = 256 * 1024 * 1024
 
     @staticmethod
     def from_wire(raw: bytes) -> "Message":
-        if len(raw) > Message.MAX_WIRE_BYTES:
+        if len(raw) > Message.MAX_CERT_WIRE_BYTES:
             raise ValueError("message too large")
+        if len(raw) > Message.MAX_WIRE_BYTES:
+            # Fast pre-parse reject: only certificate kinds may exceed the
+            # data-plane cap. A substring scan is ~100x cheaper than
+            # json.loads on a hostile 256 MiB frame; a data-plane message
+            # smuggling the substring in a string field still fails the
+            # authoritative post-parse per-type check below.
+            if (
+                b'"kind": "viewchange"' not in raw
+                and b'"kind": "newview"' not in raw
+                and b'"kind":"viewchange"' not in raw
+                and b'"kind":"newview"' not in raw
+            ):
+                raise ValueError("message too large for its type")
         try:
             d = json.loads(raw)
         except (json.JSONDecodeError, UnicodeDecodeError, RecursionError) as e:
             raise ValueError(f"undecodable message: {e}") from None
-        return Message.from_dict(d)
+        msg = Message.from_dict(d)
+        if len(raw) > type(msg).MAX_WIRE_BYTES:
+            raise ValueError("message too large for its type")
+        return msg
 
     # -- signing ------------------------------------------------------------
 
@@ -274,6 +296,7 @@ class ViewChange(Message):
     """
 
     KIND: ClassVar[str] = "viewchange"
+    MAX_WIRE_BYTES: ClassVar[int] = 64 * 1024 * 1024
 
     new_view: int = 0
     stable_seq: int = 0
@@ -286,6 +309,7 @@ class NewView(Message):
     """NEW-VIEW: the new primary's certificate installing view v+1."""
 
     KIND: ClassVar[str] = "newview"
+    MAX_WIRE_BYTES: ClassVar[int] = 256 * 1024 * 1024
 
     new_view: int = 0
     viewchange_proof: List[Dict[str, Any]] = field(default_factory=list)

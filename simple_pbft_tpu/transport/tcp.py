@@ -26,7 +26,14 @@ from typing import Dict, Iterable, Optional, Tuple
 
 log = logging.getLogger("pbft.tcp")
 
-MAX_FRAME = 16 * 1024 * 1024  # > Message.MAX_WIRE_BYTES; hard close beyond
+# Must admit the largest certificate message (NewView's 256 MiB cap,
+# messages.Message.MAX_CERT_WIRE_BYTES) — a loaded primary's failover
+# certificate has to be deliverable. RECV_BUFFER_BYTES bounds the total
+# bytes queued across ALL connections (the queue depth alone would let an
+# unauthenticated peer stack huge frames until OOM); beyond it frames drop
+# and PBFT retransmission recovers.
+MAX_FRAME = 257 * 1024 * 1024
+RECV_BUFFER_BYTES = MAX_FRAME + 64 * 1024 * 1024
 OUTBOX_DEPTH = 4096  # per-peer queued frames before drops (slow peer)
 
 
@@ -54,6 +61,7 @@ class TcpTransport:
         self.listen_addr = listen_addr
         self.peers = peers
         self._recv_q: asyncio.Queue = asyncio.Queue(maxsize=recv_depth)
+        self._recv_bytes = 0  # bytes currently queued (bounded)
         self._outboxes: Dict[str, asyncio.Queue] = {}
         self._sender_tasks: Dict[str, asyncio.Task] = {}
         self._server: Optional[asyncio.AbstractServer] = None
@@ -101,10 +109,17 @@ class TcpTransport:
                 size = int.from_bytes(header, "big")
                 if size == 0 or size > MAX_FRAME:
                     break  # protocol violation: hard close
+                if size + self._recv_bytes > RECV_BUFFER_BYTES:
+                    # drain the bytes but drop the frame: keeps the stream
+                    # framed while bounding resident memory
+                    await reader.readexactly(size)
+                    self.metrics["dropped_recv"] += 1
+                    continue
                 raw = await reader.readexactly(size)
                 self.metrics["recv"] += 1
                 try:
                     self._recv_q.put_nowait(raw)
+                    self._recv_bytes += len(raw)
                 except asyncio.QueueFull:
                     self.metrics["dropped_recv"] += 1
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
@@ -182,10 +197,14 @@ class TcpTransport:
                 await self.send(dest, raw)
 
     async def recv(self) -> bytes:
-        return await self._recv_q.get()
+        raw = await self._recv_q.get()
+        self._recv_bytes -= len(raw)
+        return raw
 
     def recv_nowait(self) -> Optional[bytes]:
         try:
-            return self._recv_q.get_nowait()
+            raw = self._recv_q.get_nowait()
         except asyncio.QueueEmpty:
             return None
+        self._recv_bytes -= len(raw)
+        return raw

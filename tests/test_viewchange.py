@@ -291,3 +291,57 @@ def test_checkpoint_proof_carries_watermark():
     assert res is not None
     _, cp_msgs, items = res
     assert len(cp_msgs) == 3 and len(items) == 3
+
+
+def test_build_view_change_dedups_multi_view_prepared_state():
+    """A seq prepared in two successive views (prepared in v, re-prepared
+    via the O-set in v+1, not committed) must emit ONE prepared proof — the
+    highest-view certificate — or validate_view_change rejects the whole
+    VIEW-CHANGE and the replica livelocks in failover (advisor finding)."""
+
+    async def main():
+        c = LocalCommittee.build(n=4, view_timeout=0)  # timers off
+        r = c.replica("r1")
+
+        # prepare the same seq in view 0 and view 1 at this replica
+        for view in (0, 1):
+            proof, pp = _prepared_proof(c.cfg, c.keys, view=view, seq=5)
+            inst = r._instance(view, 5)
+            inst.on_pre_prepare(pp)
+            for rd in proof["prepares"]:
+                from simple_pbft_tpu.messages import Message
+
+                inst.on_prepare(Message.from_dict(rd))
+            assert inst.prepared()
+
+        vc = r.vc.build_view_change(2)
+        seqs = []
+        for p in vc.prepared_proofs:
+            pp = PrePrepare(**{
+                k: v for k, v in p["pre_prepare"].items()
+                if k in ("view", "seq", "digest", "block", "sender", "sig")
+            })
+            seqs.append((pp.seq, pp.view))
+        assert seqs == [(5, 1)], seqs  # one proof, highest view wins
+        Signer("r1", c.keys["r1"].seed).sign_msg(vc)
+        assert validate_view_change(c.cfg, vc) is not None
+
+    _run(main())
+
+
+def test_wire_caps_are_per_type():
+    """Certificate messages (ViewChange/NewView) get the large wire cap;
+    data-plane messages keep the 8 MiB cap (advisor finding: a loaded
+    primary's failover certificate must stay deliverable)."""
+    from simple_pbft_tpu.messages import Message, Request
+
+    big = "x" * (9 * 1024 * 1024)
+    req = Request(client_id="c0", timestamp=1, operation=big)
+    raw = req.to_wire()
+    with pytest.raises(ValueError):
+        Message.from_wire(raw)
+
+    vc = ViewChange(new_view=1, stable_seq=0,
+                    checkpoint_proof=[{"pad": big}], prepared_proofs=[])
+    decoded = Message.from_wire(vc.to_wire())
+    assert isinstance(decoded, ViewChange)
