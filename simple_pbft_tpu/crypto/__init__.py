@@ -7,9 +7,10 @@ This package supplies that, TPU-first:
 
 - ``ed25519_cpu``: pure-Python RFC 8032 implementation — signing, and the
   known-answer verification oracle.
-- ``field_jax`` / ``ed25519_jax``: batched verification in JAX for TPU —
-  limb-decomposed GF(2^255-19) arithmetic, vmapped double-scalar
-  multiplication, verdict bitmaps.
+- ``tpu_verifier``: batched verification in JAX for TPU — limb-decomposed
+  GF(2^255-19) arithmetic (``..ops``), comb-table double-scalar
+  multiplication, verdict bitmaps, bucketed batching, key-table bank.
+- ``signer``: per-node signing identity used by every outbound message.
 - ``verifier``: the pluggable ``Verifier`` seam the consensus plane drains
   batches into (the seam sits where the reference's prepared()/committed()
   quorum predicates live, pbft_impl.go:207-232).

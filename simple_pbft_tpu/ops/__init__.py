@@ -1,4 +1,4 @@
-"""TPU-native numeric kernels: GF(2^255-19) limb arithmetic and Edwards
-curve point operations, written in pure jnp (int32) so they jit/vmap/shard
-onto TPU. The Pallas variants (ops/pallas_field.py) slot in behind the same
-API for the hot multiply."""
+"""TPU-native numeric kernels: GF(2^255-19) limb arithmetic
+(``field25519``), Edwards curve point operations (``edwards``), and the
+comb-table double-scalar multiplication kernel (``comb``) — written in
+pure jnp (int32) so they jit/vmap/shard onto TPU."""

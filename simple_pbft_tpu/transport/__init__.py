@@ -10,8 +10,9 @@ Broadcast (node.go:107-129). Redesigned:
   simulated transport the reference never had (its "cluster" was 4
   localhost processes, run.bat:19-26) and the substrate for the
   100-replica benchmark configs.
-- ``tcp`` (roadmap; lands with the multi-process milestone) —
-  length-prefixed JSON over asyncio TCP for real multi-process committees.
+- ``tcp.TcpTransport`` — length-prefixed JSON over asyncio TCP with
+  persistent reconnecting connections and bounded outboxes, for real
+  multi-process committees (see node.py / launch.py).
 """
 
 from .base import Transport  # noqa: F401
