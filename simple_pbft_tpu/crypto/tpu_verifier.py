@@ -244,12 +244,27 @@ class KeyBank:
 
     UNCACHED = -2
 
-    def __init__(self, initial_capacity: int = 8, max_keys: int = 1024):
+    def __init__(
+        self,
+        initial_capacity: int = 8,
+        max_keys: Optional[int] = None,
+        mode: str = "comb",
+    ):
+        assert mode in ("comb", "fused")
+        self._mode = mode
+        if mode == "comb":
+            self._builder = comb.comb_table_np
+            entry_shape = (comb.NPOS, comb.WINDOW, 3, 17)
+            default_max = 1024  # ~200 KB/key
+        else:
+            self._builder = comb.fused_table_np
+            entry_shape = (comb.NPOS, comb.FWINDOW, 3, 17)
+            default_max = 256  # ~3.3 MB/key: cap device memory at ~850 MB
         self._index: Dict[bytes, int] = {}
         self._invalid_cache: set = set()
-        self._max_keys = max_keys
+        self._max_keys = default_max if max_keys is None else max_keys
         self._cap = initial_capacity
-        self._np = np.zeros((self._cap, comb.NPOS, comb.WINDOW, 3, 17), np.int32)
+        self._np = np.zeros((self._cap,) + entry_shape, np.int32)
         self._dev = None
         self._dirty = True
 
@@ -275,7 +290,7 @@ class KeyBank:
             grown = np.zeros((self._cap,) + self._np.shape[1:], np.int32)
             grown[:idx] = self._np[:idx]
             self._np = grown
-        self._np[idx] = comb.comb_table_np(pt)
+        self._np[idx] = self._builder(pt)
         self._index[pubkey] = idx
         self._dirty = True
         return idx
@@ -350,12 +365,12 @@ class TpuVerifier:
     name = "tpu"
 
     def __init__(
-        self, mesh: Optional[jax.sharding.Mesh] = None, mode: str = "comb"
+        self, mesh: Optional[jax.sharding.Mesh] = None, mode: str = "fused"
     ):
-        assert mode in ("comb", "ladder")
+        assert mode in ("comb", "fused", "ladder")
         self._mesh = mesh
         self._mode = mode
-        self._bank = KeyBank() if mode == "comb" else None
+        self._bank = KeyBank(mode=mode) if mode in ("comb", "fused") else None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -366,6 +381,12 @@ class TpuVerifier:
                 self._fn = jax.jit(
                     comb.comb_verify_kernel,
                     in_shardings=(data, data, data, repl, repl, data, data, data),
+                    out_shardings=data,
+                )
+            elif mode == "fused":
+                self._fn = jax.jit(
+                    comb.fused_verify_kernel,
+                    in_shardings=(data, data, data, repl, data, data, data),
                     out_shardings=data,
                 )
             else:
@@ -386,7 +407,11 @@ class TpuVerifier:
                 )
         else:
             self._fn = jax.jit(
-                comb.comb_verify_kernel if mode == "comb" else verify_kernel
+                {
+                    "comb": comb.comb_verify_kernel,
+                    "fused": comb.fused_verify_kernel,
+                    "ladder": verify_kernel,
+                }[mode]
             )
             self._align = 1
 
@@ -402,16 +427,18 @@ class TpuVerifier:
 
     def _verify_chunk(self, items: Sequence[BatchItem]) -> List[bool]:
         size = _bucket_size(max(len(items), self._align))
-        if self._mode == "comb":
+        if self._mode in ("comb", "fused"):
             prep, fallback = prepare_comb_batch(items, self._bank)
             prep = prep.padded(size)
             s_nib, k_nib, a_idx, r_y, r_sign, precheck = prep.arrays()
             tables = self._bank.device_tables()
-            b_table = comb.base_table_device()
+            if self._mode == "comb":
+                b_table = comb.base_table_device()
+                args = (s_nib, k_nib, a_idx, tables, b_table, r_y, r_sign, precheck)
+            else:
+                args = (s_nib, k_nib, a_idx, tables, r_y, r_sign, precheck)
             # np.array (copy): fallback rows below are written in place
-            verdict = np.array(
-                self._fn(s_nib, k_nib, a_idx, tables, b_table, r_y, r_sign, precheck)
-            )
+            verdict = np.array(self._fn(*args))
             if fallback:  # keys over the bank cap: CPU path
                 for i in fallback:
                     it = items[i]

@@ -188,20 +188,13 @@ def _antidiagonal_sums(m: jnp.ndarray) -> jnp.ndarray:
     return skewed.sum(axis=-2)
 
 
-def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Field multiply: schoolbook convolution with split accumulation.
+def mul_skew(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply via the materialized outer product + skew reduction.
 
-    Relaxed-weak inputs (limbs < 2^15 + 2^11): prod[i,j] = a_i * b_j
-    < 1.22e9 < 2^31. Split each product into 15-bit lo and hi < 2^16.2;
-    lo accumulates into column i+j, hi into column i+j+1. Column sums
-    < 17 * (2^15 + 2^16.2) < 2^21; the *19 fold brings high columns back
-    with values < 20 * 2^21 < 2^26 — all safely inside int32, matching
-    normalize()'s input bound.
-
-    Designed for op-count, not FLOPs: on TPU at PBFT batch sizes every
-    fused elementwise op costs ~the same wall time (latency floor), so
-    the column accumulation uses the 3-op skew reduction instead of 34
-    slice updates.
+    Kept for A/B benchmarking against `mul` (the column-explicit form):
+    this version materializes a (..., 17, 17) product tensor and runs
+    pad/reshape/reduce ops that break XLA elementwise fusion on TPU,
+    turning the hot loop HBM-bound at large batch.
     """
     prod = a[..., :, None] * b[..., None, :]  # (..., 17, 17)
     lo_cols = _antidiagonal_sums(prod & MASK)  # (..., 34); i+j <= 32
@@ -212,6 +205,54 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     # fold: column 17+t has weight 2^255 * 2^(15t) ≡ 19 * 2^(15t)
     out = cols[..., :NLIMB] + 19 * cols[..., NLIMB:]
     return normalize(out)
+
+
+def mul_padacc(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply via 17 shifted broadcast rows (pad-accumulate).
+
+    Same arithmetic and bounds as `mul_skew`, but formulated to avoid
+    materializing the (..., 17, 17) outer product: each of the 17 partial
+    rows is a broadcast multiply a_i * b -> (..., 17), split into lo/hi,
+    and padded into its column offset of a (..., 35) accumulator. Pads and
+    elementwise ops fuse in XLA (no reshape/relayout), keeping the hot
+    loop in VMEM/registers, and the graph stays ~130 ops per multiply so
+    compile time doesn't explode (a fully column-unrolled 17x17 form is
+    ~1400 ops/mul and took minutes to compile).
+    """
+    ndim1 = a.ndim - 1
+    acc = jnp.zeros(a.shape[:-1] + (2 * NLIMB + 1,), dtype=a.dtype)
+    for i in range(NLIMB):
+        p = a[..., i : i + 1] * b  # (..., 17)
+        lo = p & MASK
+        hi = p >> RADIX
+        acc = acc + jnp.pad(lo, [(0, 0)] * ndim1 + [(i, NLIMB - i + 1)])
+        acc = acc + jnp.pad(hi, [(0, 0)] * ndim1 + [(i + 1, NLIMB - i)])
+    # fold: column 17+t has weight 2^255 * 2^(15t) ≡ 19 * 2^(15t);
+    # column 34 (top hi) is always zero since hi of a_16*b_16 lands at 33
+    out = acc[..., :NLIMB] + 19 * acc[..., NLIMB : 2 * NLIMB]
+    return normalize(out)
+
+
+# The production field multiply. `mul_padacc` is selectable for A/B
+# benchmarking on real hardware (bench.py / profiling runs): it avoids
+# materializing the (..., 17, 17) outer product but compiles ~20x slower
+# (pads defeat XLA's cheap fusion planning), so the default stays `skew`
+# until the padacc runtime win is measured on the chip.
+mul = mul_skew
+
+# The exponentiation chains unroll ~300 sequential multiplies on tiny
+# (often (1, 17)) operands — runtime-negligible but compile-dominating.
+# They always use the compact skew form (~25 HLO ops/mul vs ~135) so the
+# hot-path mul choice doesn't balloon compile times 5-10x.
+_chain_mul = mul_skew
+
+
+def use_mul_impl(name: str) -> None:
+    """Select the hot-path field-multiply formulation ('padacc' or 'skew')
+    BEFORE any kernel is jitted — jit traces capture whatever `mul` is
+    bound to at trace time."""
+    global mul
+    mul = {"padacc": mul_padacc, "skew": mul_skew}[name]
 
 
 def sq(a: jnp.ndarray) -> jnp.ndarray:
@@ -233,46 +274,46 @@ def _sqn(x: jnp.ndarray, n: int) -> jnp.ndarray:
     """x^(2^n) via n squarings (fori_loop keeps the XLA graph small)."""
     if n <= 4:
         for _ in range(n):
-            x = sq(x)
+            x = _chain_mul(x, x)
         return x
-    return lax.fori_loop(0, n, lambda _, v: sq(v), x)
+    return lax.fori_loop(0, n, lambda _, v: _chain_mul(v, v), x)
 
 
 def _chain_250(x: jnp.ndarray):
     """Shared prefix: returns (x^(2^250 - 1), x^11, x^2)."""
-    z2 = sq(x)
+    z2 = _chain_mul(x, x)
     z8 = _sqn(z2, 2)
-    z9 = mul(x, z8)
-    z11 = mul(z2, z9)
-    z22 = sq(z11)
-    z_5_0 = mul(z9, z22)  # x^(2^5 - 1)
+    z9 = _chain_mul(x, z8)
+    z11 = _chain_mul(z2, z9)
+    z22 = _chain_mul(z11, z11)
+    z_5_0 = _chain_mul(z9, z22)  # x^(2^5 - 1)
     z_10_5 = _sqn(z_5_0, 5)
-    z_10_0 = mul(z_10_5, z_5_0)  # x^(2^10 - 1)
+    z_10_0 = _chain_mul(z_10_5, z_5_0)  # x^(2^10 - 1)
     z_20_10 = _sqn(z_10_0, 10)
-    z_20_0 = mul(z_20_10, z_10_0)
+    z_20_0 = _chain_mul(z_20_10, z_10_0)
     z_40_20 = _sqn(z_20_0, 20)
-    z_40_0 = mul(z_40_20, z_20_0)
+    z_40_0 = _chain_mul(z_40_20, z_20_0)
     z_50_10 = _sqn(z_40_0, 10)
-    z_50_0 = mul(z_50_10, z_10_0)
+    z_50_0 = _chain_mul(z_50_10, z_10_0)
     z_100_50 = _sqn(z_50_0, 50)
-    z_100_0 = mul(z_100_50, z_50_0)
+    z_100_0 = _chain_mul(z_100_50, z_50_0)
     z_200_100 = _sqn(z_100_0, 100)
-    z_200_0 = mul(z_200_100, z_100_0)
+    z_200_0 = _chain_mul(z_200_100, z_100_0)
     z_250_50 = _sqn(z_200_0, 50)
-    z_250_0 = mul(z_250_50, z_50_0)  # x^(2^250 - 1)
+    z_250_0 = _chain_mul(z_250_50, z_50_0)  # x^(2^250 - 1)
     return z_250_0, z11, z2
 
 
 def invert(x: jnp.ndarray) -> jnp.ndarray:
     """x^(p-2) = x^(2^255 - 21): multiplicative inverse (0 -> 0)."""
     z_250_0, z11, _ = _chain_250(x)
-    return mul(_sqn(z_250_0, 5), z11)
+    return _chain_mul(_sqn(z_250_0, 5), z11)
 
 
 def pow22523(x: jnp.ndarray) -> jnp.ndarray:
     """x^((p-5)/8) = x^(2^252 - 3) — the square-root helper exponent."""
     z_250_0, _, _ = _chain_250(x)
-    return mul(_sqn(z_250_0, 2), x)
+    return _chain_mul(_sqn(z_250_0, 2), x)
 
 
 # ---------------------------------------------------------------------------
