@@ -107,7 +107,7 @@ def test_keybank_cap_falls_back_to_cpu():
     from simple_pbft_tpu.crypto.tpu_verifier import KeyBank
 
     v = TpuVerifier()
-    v._bank = KeyBank(initial_capacity=2, max_keys=2)
+    v._bank = KeyBank(initial_capacity=2, max_keys=2, mode=v._mode)
     items = [_signed(i, b"cap %d" % i) for i in range(4)]  # 4 distinct keys
     bad = bytearray(items[3].sig)
     bad[2] ^= 4
