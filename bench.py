@@ -18,7 +18,10 @@ is device throughput (host prep overlaps with device compute in the
 pipelined runtime — see crypto/tpu_verifier.py).
 
 Env knobs: BENCH_BATCH (top batch size), BENCH_SIGNERS, BENCH_TIMEOUT
-(wall-clock budget in seconds, default 420), --smoke (tiny CPU run for CI).
+(wall-clock budget in seconds, default 420), BENCH_MODE (fused|comb —
+fused is one gather + one mixed add per nibble position, half the comb
+engine's madds), BENCH_MUL (skew|padacc field-multiply formulation),
+--smoke (tiny CPU run for CI).
 """
 
 from __future__ import annotations
@@ -106,6 +109,11 @@ def main() -> None:
 
     import jax.numpy as jnp
 
+    from simple_pbft_tpu.ops import field25519 as fe
+
+    mul_impl = os.environ.get("BENCH_MUL", "padacc")
+    fe.use_mul_impl(mul_impl)  # must precede any jit trace
+
     from simple_pbft_tpu.ops import comb
     from simple_pbft_tpu.crypto import ed25519_cpu as ref
     from simple_pbft_tpu.crypto.verifier import BatchItem
@@ -115,6 +123,8 @@ def main() -> None:
         prepare_comb_batch,
     )
 
+    mode = os.environ.get("BENCH_MODE", "fused")
+    assert mode in ("fused", "comb"), mode
     platform = jax.devices()[0].platform
     top_batch = int(os.environ.get("BENCH_BATCH", str(BUCKETS[-1])))
     # comb kernel's batch inversion needs a power-of-two batch
@@ -130,19 +140,33 @@ def main() -> None:
         msg = b"bench vote %d" % i
         items.append(BatchItem(ref.public_key(seed), msg, ref.sign(seed, msg)))
 
-    bank = KeyBank()
+    bank = KeyBank(mode=mode)
+    _best["note"] = f"building {mode} key tables ({n_signers} keys)"
+    t0 = time.perf_counter()
+    for it in items:
+        bank.lookup(it.pubkey)  # warm the bank: table build is one-time
+    table_build_s = time.perf_counter() - t0
+
     t0 = time.perf_counter()
     prep, _fallback = prepare_comb_batch(items, bank)
     prep_per_item_us = (time.perf_counter() - t0) / distinct * 1e6
 
     base_arrays = prep.arrays()
     tables = bank.device_tables()
-    b_table = jnp.asarray(comb.base_table())
 
-    def fn(s_nib, k_nib, a_idx, r_y, r_sign, precheck):
-        return comb.comb_verify_kernel(
-            s_nib, k_nib, a_idx, tables, b_table, r_y, r_sign, precheck
-        )
+    if mode == "comb":
+        b_table = comb.base_table_device()
+
+        def fn(s_nib, k_nib, a_idx, r_y, r_sign, precheck):
+            return comb.comb_verify_kernel(
+                s_nib, k_nib, a_idx, tables, b_table, r_y, r_sign, precheck
+            )
+    else:
+
+        def fn(s_nib, k_nib, a_idx, r_y, r_sign, precheck):
+            return comb.fused_verify_kernel(
+                s_nib, k_nib, a_idx, tables, r_y, r_sign, precheck
+            )
 
     fn = jax.jit(fn)
 
@@ -151,8 +175,8 @@ def main() -> None:
 
     def staged(batch: int):
         reps = batch // distinct
-        return [
-            jax.device_put(np.concatenate([a] * reps, axis=0))
+        return [  # batch axis is trailing on every prepared array
+            jax.device_put(np.concatenate([a] * reps, axis=-1))
             for a in base_arrays
         ]
 
@@ -197,13 +221,17 @@ def main() -> None:
     _best["note"] = best_note
 
     print(
-        f"host_prep={prep_per_item_us:.1f}us/item device={platform} "
+        f"host_prep={prep_per_item_us:.1f}us/item "
+        f"table_build={table_build_s:.1f}s device={platform} "
         f"best={_best['value']:,.0f}/s ({_best['note']})",
         file=sys.stderr,
     )
     _emit(
         host_prep_us_per_item=round(prep_per_item_us, 1),
+        table_build_s=round(table_build_s, 1),
         platform=platform,
+        mode=mode,
+        mul=mul_impl,
     )
 
 

@@ -8,17 +8,20 @@ those verifies would sit). This module fills that gap TPU-first:
 
 - The consensus plane drains every pending (pubkey, message, signature)
   tuple into one batch.
-- Host prep (vectorized numpy + hashlib) decodes wire bytes into fixed-shape
-  int32 arrays: field limbs, sign bits, scalar bit matrices, and a
-  "precheck" mask for host-detectable failures (bad lengths, non-canonical
-  S ≥ L, non-canonical y ≥ p).
-- One jitted device pass per batch: decompress A and R, run the interleaved
-  Straus ladder for [S]B + [k](−A), and compare against R projectively.
-  Constant shapes, no data-dependent control flow — every signature costs
-  exactly the same fixed ladder, so XLA compiles one kernel per bucket size.
+- Host prep is fully vectorized: wire bytes are decoded with numpy (one
+  join + frombuffer per batch, no per-item Python), and the challenge
+  scalars k = SHA-512(R||A||M) mod L come from the native OpenMP batch
+  hasher (simple_pbft_tpu/native/) — sub-microsecond per item, so the
+  host keeps up with the device instead of capping it.
+- One jitted device pass per batch (comb engine by default — see
+  ops/comb.py; or the self-contained Straus ladder). Constant shapes, no
+  data-dependent control flow — every signature costs the same fixed
+  sequence, so XLA compiles one kernel per bucket size.
+- Device arrays are limb-major / batch-minor ((17, B) etc., see
+  ops/field25519.py) so the batch fills the vector lanes.
 - Batches are padded to bucketed sizes (powers of two) so recompiles are
-  bounded; the verdict bitmap maps back per item, so one bad signature never
-  poisons a quorum that still holds 2f+1 valid votes (SURVEY.md §7
+  bounded; the verdict bitmap maps back per item, so one bad signature
+  never poisons a quorum that still holds 2f+1 valid votes (SURVEY.md §7
   "Correct Byzantine semantics under batching").
 
 Verification equation (cofactorless, RFC 8032 permits): [S]B == R + [k]A,
@@ -34,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import native
 from ..ops import comb
 from ..ops import edwards as ed
 from ..ops import field25519 as fe
@@ -46,9 +50,13 @@ BUCKETS = (8, 32, 128, 512, 2048, 8192)
 
 _L_BYTES = ref.L.to_bytes(32, "little")
 
+_ZERO32 = bytes(32)
+_ZERO64 = bytes(64)
+
 
 # ---------------------------------------------------------------------------
-# Host-side batch preparation (numpy-vectorized where it matters)
+# Host-side batch preparation (numpy + native hashing; no per-item Python
+# beyond dict lookups and byte-string joins)
 # ---------------------------------------------------------------------------
 
 
@@ -83,13 +91,34 @@ def _bits_msb_first_np(le_bytes: np.ndarray) -> np.ndarray:
     return bits[:, ::-1].astype(np.int32)
 
 
+def _split_items(items: Sequence[BatchItem]):
+    """Items -> (pub (n,32), r (n,32), s (n,32), msgs list, wellformed
+    (n,) bool) with malformed rows zeroed — one join per field, no
+    per-item numpy."""
+    n = len(items)
+    ok = np.ones(n, dtype=bool)
+    pubs: List[bytes] = []
+    sigs: List[bytes] = []
+    msgs: List[bytes] = []
+    for i, it in enumerate(items):
+        good = len(it.pubkey) == 32 and len(it.sig) == 64
+        if not good:
+            ok[i] = False
+        pubs.append(it.pubkey if good else _ZERO32)
+        sigs.append(it.sig if good else _ZERO64)
+        msgs.append(it.msg)
+    pub = np.frombuffer(b"".join(pubs), dtype=np.uint8).reshape(n, 32)
+    sig = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(n, 64)
+    return pub, sig[:, :32], sig[:, 32:], msgs, ok
+
+
 def _pad_batch_arrays(arrays, n: int, size: int):
-    """Zero-pad each array's leading batch dim from n to size."""
+    """Zero-pad each array's TRAILING (batch) dim from n to size."""
     assert size >= n, f"pad target {size} < batch {n}"
     pad = size - n
 
     def pz(a):
-        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        widths = [(0, 0)] * (a.ndim - 1) + [(0, pad)]
         return np.pad(a, widths)
 
     return tuple(pz(a) for a in arrays)
@@ -97,7 +126,8 @@ def _pad_batch_arrays(arrays, n: int, size: int):
 
 class PreparedBatch:
     """Fixed-shape device-ready arrays for one verify batch of size n
-    (pre-padding). Field order matches _device_verify's signature."""
+    (pre-padding). Field order matches verify_kernel's signature; the
+    batch axis is trailing on every array."""
 
     __slots__ = ("n", "a_y", "a_sign", "r_y", "r_sign", "s_bits", "k_bits", "precheck")
 
@@ -136,49 +166,33 @@ def prepare_batch(items: Sequence[BatchItem]) -> PreparedBatch:
     Malformed items (wrong lengths) stay in the batch as dummy rows with
     precheck=False — keeping shapes static is cheaper than compacting.
     """
-    n = len(items)
-    a_raw = np.zeros((n, 32), dtype=np.uint8)
-    r_raw = np.zeros((n, 32), dtype=np.uint8)
-    s_raw = np.zeros((n, 32), dtype=np.uint8)
-    k_le = np.zeros((n, 32), dtype=np.uint8)
-    ok = np.ones(n, dtype=bool)
-
-    for i, it in enumerate(items):
-        if len(it.pubkey) != 32 or len(it.sig) != 64:
-            ok[i] = False
-            continue
-        a_raw[i] = np.frombuffer(it.pubkey, dtype=np.uint8)
-        r_raw[i] = np.frombuffer(it.sig[:32], dtype=np.uint8)
-        s_raw[i] = np.frombuffer(it.sig[32:], dtype=np.uint8)
-        # challenge k = SHA-512(R || A || M) mod L; host-side hashing —
-        # sequential, cheap relative to the device ladder (SURVEY.md §7).
-        k = ref.challenge_scalar(it.sig[:32], it.pubkey, it.msg)
-        k_le[i] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
+    pub, r_raw, s_raw, msgs, ok = _split_items(items)
+    k_le = native.challenge_batch(r_raw, pub, msgs)
 
     # host-detectable rejects: non-canonical S, non-canonical y encodings
     ok &= ~_ge_l_np(s_raw)
-    ok &= ~_ge_p_np(a_raw)
+    ok &= ~_ge_p_np(pub)
     ok &= ~_ge_p_np(r_raw)
 
     return PreparedBatch(
-        n,
-        fe.bytes32_to_limbs_np(a_raw),
-        fe.sign_bits_np(a_raw),
-        fe.bytes32_to_limbs_np(r_raw),
+        len(items),
+        np.ascontiguousarray(fe.bytes32_to_limbs_np(pub).T),
+        fe.sign_bits_np(pub),
+        np.ascontiguousarray(fe.bytes32_to_limbs_np(r_raw).T),
         fe.sign_bits_np(r_raw),
-        _bits_msb_first_np(s_raw),
-        _bits_msb_first_np(k_le),
+        np.ascontiguousarray(_bits_msb_first_np(s_raw).T),
+        np.ascontiguousarray(_bits_msb_first_np(k_le).T),
         ok,
     )
 
 
 # ---------------------------------------------------------------------------
-# Device kernel
+# Device kernel (ladder mode — self-contained, no key cache)
 # ---------------------------------------------------------------------------
 
 
 def verify_kernel(a_y, a_sign, r_y, r_sign, s_bits, k_bits, precheck):
-    """The jittable batched verify: (B, ...) arrays in, (B,) bool out.
+    """The jittable batched verify: limb/bit-major arrays in, (B,) bool out.
 
     Every row runs the identical fixed ladder; invalid decompressions
     produce garbage points whose verdicts are ANDed away — no branches.
@@ -187,8 +201,8 @@ def verify_kernel(a_y, a_sign, r_y, r_sign, s_bits, k_bits, precheck):
     r_pt, ok_r = ed.decompress(r_y, r_sign)
     acc = ed.double_scalar_mul_base(s_bits, k_bits, ed.point_neg(a_pt))
     # acc == R, projectively (R has Z = 1): X*1 == x_R * Z, Y*1 == y_R * Z
-    x, y, z = acc[..., 0, :], acc[..., 1, :], acc[..., 2, :]
-    x_r, y_r = r_pt[..., 0, :], r_pt[..., 1, :]
+    x, y, z = acc[0], acc[1], acc[2]
+    x_r, y_r = r_pt[0], r_pt[1]
     eq = fe.eq(x, fe.mul(x_r, z)) & fe.eq(y, fe.mul(y_r, z))
     return eq & ok_a & ok_r & precheck
 
@@ -206,7 +220,8 @@ def _bucket_size(n: int) -> int:
 
 
 class CombBatch:
-    """Device-ready arrays for the comb kernel (pre-padding)."""
+    """Device-ready arrays for the comb kernels (pre-padding); batch axis
+    trailing on every array."""
 
     __slots__ = ("n", "s_nib", "k_nib", "a_idx", "r_y", "r_sign", "precheck")
 
@@ -232,14 +247,14 @@ class KeyBank:
     """Cache of per-pubkey comb tables (the committee's key set).
 
     PBFT pubkeys are few and endlessly reused, so each is decompressed and
-    expanded into a Niels comb table once on the host (exact bigints) and
+    expanded into packed Niels rows once on the host (exact bigints) and
     kept on device. The bank's capacity grows in powers of two so kernel
     shapes (and thus compiles) change only on committee growth.
 
     `max_keys` bounds the bank: a Byzantine sender must not be able to
-    grow device memory (~200 KB/key) and force recompiles by spraying
-    fresh valid curve points through the Verifier seam. Keys beyond the
-    cap report UNCACHED and are verified on the CPU fallback path.
+    grow device memory and force recompiles by spraying fresh valid curve
+    points through the Verifier seam. Keys beyond the cap report UNCACHED
+    and are verified on the CPU fallback path.
     """
 
     UNCACHED = -2
@@ -254,17 +269,17 @@ class KeyBank:
         self._mode = mode
         if mode == "comb":
             self._builder = comb.comb_table_np
-            entry_shape = (comb.NPOS, comb.WINDOW, 3, 17)
-            default_max = 1024  # ~200 KB/key
+            self._rows_per_key = comb.NPOS * comb.WINDOW
+            default_max = 1024  # ~260 KB/key
         else:
             self._builder = comb.fused_table_np
-            entry_shape = (comb.NPOS, comb.FWINDOW, 3, 17)
-            default_max = 256  # ~3.3 MB/key: cap device memory at ~850 MB
+            self._rows_per_key = comb.NPOS * comb.FWINDOW
+            default_max = 256  # ~4.2 MB/key: cap device memory at ~1 GB
         self._index: Dict[bytes, int] = {}
         self._invalid_cache: set = set()
         self._max_keys = default_max if max_keys is None else max_keys
         self._cap = initial_capacity
-        self._np = np.zeros((self._cap,) + entry_shape, np.int32)
+        self._np = np.zeros((self._cap, self._rows_per_key, comb.ROW), np.int32)
         self._dev = None
         self._dirty = True
 
@@ -296,8 +311,11 @@ class KeyBank:
         return idx
 
     def device_tables(self) -> jnp.ndarray:
+        """Flat (cap * rows_per_key, ROW) packed-row table on device."""
         if self._dirty or self._dev is None:
-            self._dev = jnp.asarray(self._np)
+            self._dev = jnp.asarray(
+                self._np.reshape(self._cap * self._rows_per_key, comb.ROW)
+            )
             self._dirty = False
         return self._dev
 
@@ -309,39 +327,37 @@ def prepare_comb_batch(
 
     Returns (batch, fallback): `fallback` lists item positions whose
     pubkey is valid but over the bank's cap — the caller must verify
-    those on the CPU path (their device rows are masked out)."""
+    those on the CPU path (their device rows are masked out).
+
+    Vectorized end to end: the only per-item Python is the bank's dict
+    lookup; decoding is one join + frombuffer per field and the challenge
+    scalars come from the native batch hasher.
+    """
     n = len(items)
-    s_raw = np.zeros((n, 32), dtype=np.uint8)
-    k_raw = np.zeros((n, 32), dtype=np.uint8)
-    r_raw = np.zeros((n, 32), dtype=np.uint8)
+    pub, r_raw, s_raw, msgs, ok = _split_items(items)
     a_idx = np.zeros(n, dtype=np.int32)
-    ok = np.ones(n, dtype=bool)
     fallback: List[int] = []
 
     for i, it in enumerate(items):
         idx = bank.lookup(it.pubkey)
-        if idx == KeyBank.UNCACHED:
+        if idx >= 0:
+            a_idx[i] = idx
+        else:
             ok[i] = False
-            fallback.append(i)
-            continue
-        if idx < 0 or len(it.sig) != 64:
-            ok[i] = False
-            continue
-        a_idx[i] = idx
-        r_raw[i] = np.frombuffer(it.sig[:32], dtype=np.uint8)
-        s_raw[i] = np.frombuffer(it.sig[32:], dtype=np.uint8)
-        k = ref.challenge_scalar(it.sig[:32], it.pubkey, it.msg)
-        k_raw[i] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
+            if idx == KeyBank.UNCACHED:
+                fallback.append(i)
+
+    k_raw = native.challenge_batch(r_raw, pub, msgs)
 
     ok &= ~_ge_l_np(s_raw)
     ok &= ~_ge_p_np(r_raw)
 
     batch = CombBatch(
         n,
-        comb.nibbles_np(s_raw),
-        comb.nibbles_np(k_raw),
+        np.ascontiguousarray(comb.nibbles_np(s_raw).T),
+        np.ascontiguousarray(comb.nibbles_np(k_raw).T),
         a_idx,
-        fe.bytes32_to_limbs_np(r_raw),
+        np.ascontiguousarray(fe.bytes32_to_limbs_np(r_raw).T),
         fe.sign_bits_np(r_raw),
         ok,
     )
@@ -351,10 +367,12 @@ def prepare_comb_batch(
 class TpuVerifier:
     """The `tpu` backend behind the crypto.Verifier seam.
 
-    Default mode is the comb engine (ops/comb.py): cached per-pubkey comb
-    tables, zero doublings, no on-device decompression, batch-amortized
-    inversion. `mode="ladder"` selects the self-contained Straus ladder
-    (no key cache — useful when pubkeys are unbounded).
+    Default mode is the fused comb engine (ops/comb.py): cached per-pubkey
+    dual-scalar tables, zero doublings, no on-device decompression, one
+    madd per nibble position, batch-amortized inversion. `mode="comb"`
+    halves table memory for twice the madds; `mode="ladder"` selects the
+    self-contained Straus ladder (no key cache — useful when pubkeys are
+    unbounded).
 
     Pads drained batches to bucketed sizes, runs one jitted device pass per
     chunk, and returns the per-item bitmap. Pass a `jax.sharding.Mesh` via
@@ -375,25 +393,26 @@ class TpuVerifier:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             axis = mesh.axis_names[0]
-            data = NamedSharding(mesh, P(axis))
+            vec = NamedSharding(mesh, P(axis))  # (B,)
+            mat = NamedSharding(mesh, P(None, axis))  # (limb/pos, B)
             repl = NamedSharding(mesh, P())
             if mode == "comb":
                 self._fn = jax.jit(
                     comb.comb_verify_kernel,
-                    in_shardings=(data, data, data, repl, repl, data, data, data),
-                    out_shardings=data,
+                    in_shardings=(mat, mat, vec, repl, repl, mat, vec, vec),
+                    out_shardings=vec,
                 )
             elif mode == "fused":
                 self._fn = jax.jit(
                     comb.fused_verify_kernel,
-                    in_shardings=(data, data, data, repl, data, data, data),
-                    out_shardings=data,
+                    in_shardings=(mat, mat, vec, repl, mat, vec, vec),
+                    out_shardings=vec,
                 )
             else:
                 self._fn = jax.jit(
                     verify_kernel,
-                    in_shardings=(data,) * 7,
-                    out_shardings=data,
+                    in_shardings=(mat, vec, mat, vec, mat, mat, vec),
+                    out_shardings=vec,
                 )
             self._align = int(np.prod(mesh.devices.shape))
             if self._align & (self._align - 1):

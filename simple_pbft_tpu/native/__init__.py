@@ -1,0 +1,163 @@
+"""ctypes loader for the native host-prep library (pbft_native.cpp).
+
+The shared object is built on demand with g++ (cached next to the source,
+rebuilt when the source is newer) and loaded via ctypes — no pybind11
+dependency. Every entry point has a pure-Python fallback so the framework
+works on machines without a toolchain; `available()` reports which path is
+active and the bench records it.
+
+API (numpy in, numpy out, zero per-item Python work):
+- challenge_batch(r, a, msgs) -> (n, 32) uint8 little-endian scalars
+  k_i = SHA-512(R_i || A_i || M_i) mod L   (the Ed25519 challenge)
+- sha512_batch(msgs) -> (n, 64) uint8 digests
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "pbft_native.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "_pbft_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-fopenmp", "-shared", "-fPIC",
+        "-o", _SO + ".tmp", _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        detail = getattr(e, "stderr", b"") or b""
+        log.warning("native build failed (%s) %s — using Python fallback",
+                    e, detail.decode(errors="replace")[:500])
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        fresh = os.path.exists(_SO) and (
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+        )
+        if not fresh and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            log.warning("native load failed: %s — using Python fallback", e)
+            return None
+        lib.challenge_batch.argtypes = [
+            _u8p, _u8p, _u8p, _i64p, ctypes.c_int64, _u8p,
+        ]
+        lib.challenge_batch.restype = None
+        lib.sha512_batch.argtypes = [_u8p, _i64p, ctypes.c_int64, _u8p]
+        lib.sha512_batch.restype = None
+        lib.sc_reduce_batch.argtypes = [_u8p, ctypes.c_int64, _u8p]
+        lib.sc_reduce_batch.restype = None
+        lib.native_num_threads.argtypes = []
+        lib.native_num_threads.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def num_threads() -> int:
+    lib = _load()
+    return lib.native_num_threads() if lib is not None else 1
+
+
+def _concat_offsets(msgs: Sequence[bytes]):
+    offs = np.zeros(len(msgs) + 1, dtype=np.int64)
+    np.cumsum([len(m) for m in msgs], out=offs[1:])
+    cat = b"".join(msgs)
+    buf = np.frombuffer(cat, dtype=np.uint8) if cat else np.zeros(1, np.uint8)
+    return np.ascontiguousarray(buf), offs
+
+
+def challenge_batch(
+    r: np.ndarray, a: np.ndarray, msgs: Sequence[bytes]
+) -> np.ndarray:
+    """(n, 32) R encodings, (n, 32) A encodings, n message byte strings ->
+    (n, 32) uint8 little-endian challenge scalars (mod L, canonical)."""
+    n = len(msgs)
+    assert r.shape == (n, 32) and a.shape == (n, 32), (r.shape, a.shape)
+    out = np.empty((n, 32), dtype=np.uint8)
+    if n == 0:
+        return out
+    lib = _load()
+    if lib is not None:
+        cat, offs = _concat_offsets(msgs)
+        lib.challenge_batch(
+            np.ascontiguousarray(r), np.ascontiguousarray(a),
+            cat, offs, n, out,
+        )
+        return out
+    from ..crypto import ed25519_cpu as ref  # fallback: per-item Python
+
+    for i, m in enumerate(msgs):
+        k = ref.challenge_scalar(r[i].tobytes(), a[i].tobytes(), m)
+        out[i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
+    return out
+
+
+def sc_reduce_batch(digests: np.ndarray) -> np.ndarray:
+    """(n, 64) uint8 little-endian 512-bit values -> (n, 32) uint8
+    canonical scalars mod L (the Ed25519 group order)."""
+    n = len(digests)
+    assert digests.shape == (n, 64), digests.shape
+    out = np.empty((n, 32), dtype=np.uint8)
+    if n == 0:
+        return out
+    lib = _load()
+    if lib is not None:
+        lib.sc_reduce_batch(np.ascontiguousarray(digests), n, out)
+        return out
+    from ..crypto import ed25519_cpu as ref  # fallback: per-item Python
+
+    for i in range(n):
+        v = int.from_bytes(digests[i].tobytes(), "little") % ref.L
+        out[i] = np.frombuffer(v.to_bytes(32, "little"), np.uint8)
+    return out
+
+
+def sha512_batch(msgs: Sequence[bytes]) -> np.ndarray:
+    """n message byte strings -> (n, 64) uint8 SHA-512 digests."""
+    n = len(msgs)
+    out = np.empty((n, 64), dtype=np.uint8)
+    if n == 0:
+        return out
+    lib = _load()
+    if lib is not None:
+        cat, offs = _concat_offsets(msgs)
+        lib.sha512_batch(cat, offs, n, out)
+        return out
+    import hashlib
+
+    for i, m in enumerate(msgs):
+        out[i] = np.frombuffer(hashlib.sha512(m).digest(), np.uint8)
+    return out

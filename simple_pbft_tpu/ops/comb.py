@@ -19,9 +19,20 @@ A and R). PBFT gives us structure the TPU can exploit:
   canonical encoding (y limbs + x parity) against R's wire bytes. A
   non-canonical or off-curve R simply never matches.
 
-Per-signature device cost: 128 mixed adds (7 field muls each) + ~3 muls of
-batch inversion ≈ 900 field muls, vs ≈ 4300 + two 250-square chains for
-the ladder — and the table lookups are two bulk gathers, not where-chains.
+TPU-native data layout (what makes this fast, not just op-lean):
+
+- Tables live in HBM as PACKED ROWS: one (64,) int32 row per Niels entry
+  = [y+x limbs | y−x limbs | 2dxy limbs | pad] — so fetching an entry is
+  one dense 256-byte row read. All 64 positions' rows for the whole batch
+  are fetched in ONE flat `jnp.take` (measured ~230M rows/s on a v5e,
+  vs ~11M rows/s for 64 per-position gathers in a loop).
+- Compute arrays are limb-major / batch-minor ((17, B), see
+  ops/field25519.py): the batch fills the 128-wide vector lanes, making
+  the 64-iteration madd loop VPU-dense.
+
+Per-signature device cost (fused mode): 64 mixed adds (7 field muls each)
++ ~3 muls of batch inversion ≈ 450 field muls, vs ≈ 4300 + two 250-square
+chains for the ladder.
 
 Everything stays constant-shape: 64 nibble positions whatever the scalar,
 identity entries for zero nibbles, verdicts masked by host prechecks.
@@ -29,7 +40,7 @@ identity entries for zero nibbles, verdicts masked by host prechecks.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -41,40 +52,23 @@ from ..crypto import ed25519_cpu as ref
 NPOS = 64  # 4-bit comb positions covering 256-bit scalars
 WINDOW = 16
 FWINDOW = WINDOW * WINDOW  # fused (s_nibble, k_nibble) window: 256 entries
+ROW = 64  # packed Niels row: 3*17 int32 limbs + 13 pad to a 256B row
 
 # ---------------------------------------------------------------------------
-# Host-side table construction (exact Python bigints -> limb arrays)
+# Host-side table construction (exact Python bigints -> packed limb rows)
 # ---------------------------------------------------------------------------
 
 
-def _niels_np(p: ref.Point) -> np.ndarray:
-    """Affine Niels form (y+x, y−x, 2dxy) as (3, 17) int32 limbs."""
-    x, y = ref.point_to_affine(p)
-    return np.stack(
-        [
-            fe._int_to_limbs_np((y + x) % ref.P),
-            fe._int_to_limbs_np((y - x) % ref.P),
-            fe._int_to_limbs_np(2 * ref.D * x * y % ref.P),
-        ]
-    )
-
-
-def comb_table_np(point: ref.Point) -> np.ndarray:
-    """(NPOS, WINDOW, 3, 17) int32: T[i][w] = (w * 16^i) * point, Niels."""
-    out = np.zeros((NPOS, WINDOW, 3, 17), dtype=np.int32)
-    base = point
-    for i in range(NPOS):
-        acc = ref.IDENTITY
-        for w in range(WINDOW):
-            out[i, w] = _niels_np(acc)
-            acc = ref.point_add(acc, base)
-        for _ in range(4):  # base <- 16 * base
-            base = ref.point_double(base)
+def _pack_rows_np(vals: np.ndarray) -> np.ndarray:
+    """(n, 3, 17) int32 Niels limbs -> (n, ROW) packed rows."""
+    n = vals.shape[0]
+    out = np.zeros((n, ROW), dtype=np.int32)
+    out[:, : 3 * fe.NLIMB] = vals.reshape(n, 3 * fe.NLIMB)
     return out
 
 
 def _batch_affine_niels_np(points) -> np.ndarray:
-    """Extended bigint points -> (n, 3, 17) int32 Niels limbs, with ONE
+    """Extended bigint points -> (n, ROW) packed Niels rows, with ONE
     modular inversion for the whole list (host Montgomery batch trick) and
     vectorized int->limb conversion. comb_table-scale builds do tens of
     thousands of entries per key; per-entry Fermat inversions would cost
@@ -98,7 +92,22 @@ def _batch_affine_niels_np(points) -> np.ndarray:
         vals[i, 2] = np.frombuffer(
             (2 * ref.D * x * y % ref.P).to_bytes(32, "little"), np.uint8
         )
-    return fe.bytes32_to_limbs_np(vals.reshape(n * 3, 32)).reshape(n, 3, 17)
+    limbs = fe.bytes32_to_limbs_np(vals.reshape(n * 3, 32)).reshape(n, 3, fe.NLIMB)
+    return _pack_rows_np(limbs)
+
+
+def comb_table_np(point: ref.Point) -> np.ndarray:
+    """(NPOS * WINDOW, ROW) packed rows: row[i*W + w] = (w * 16^i) * point."""
+    pts = []
+    base = point
+    for i in range(NPOS):
+        acc = ref.IDENTITY
+        for w in range(WINDOW):
+            pts.append(acc)
+            acc = ref.point_add(acc, base)
+        for _ in range(4):  # base <- 16 * base
+            base = ref.point_double(base)
+    return _batch_affine_niels_np(pts)
 
 
 def _point_neg(p: ref.Point) -> ref.Point:
@@ -107,14 +116,15 @@ def _point_neg(p: ref.Point) -> ref.Point:
 
 
 def fused_table_np(point: ref.Point) -> np.ndarray:
-    """(NPOS, FWINDOW, 3, 17) int32 Niels:
-    T[i][ws*16 + wk] = (ws * 16^i) B + (wk * 16^i) (−A).
+    """(NPOS * FWINDOW, ROW) packed rows:
+    row[i*FW + ws*16 + wk] = (ws * 16^i) B + (wk * 16^i) (−A).
 
-    One gather + ONE mixed add per nibble position evaluates
+    One row fetch + ONE mixed add per nibble position evaluates
     [S]B + [k](−A) — half the madds of the separate-table comb (the
     device cost per signature drops from 128 to 64 mixed adds). The
-    16x-larger table trades HBM capacity (3.3 MB/key) for compute; keys
-    are few (a committee) and endlessly reused, so the build amortizes.
+    16x-larger table trades HBM capacity (~4.2 MB/key packed) for
+    compute; keys are few (a committee) and endlessly reused, so the
+    build amortizes.
     """
     pts = []
     base_b = ref.B
@@ -130,7 +140,7 @@ def fused_table_np(point: ref.Point) -> np.ndarray:
         for _ in range(4):  # bases <- 16 * bases
             base_b = ref.point_double(base_b)
             base_a = ref.point_double(base_a)
-    return _batch_affine_niels_np(pts).reshape(NPOS, FWINDOW, 3, 17)
+    return _batch_affine_niels_np(pts)
 
 
 _BASE_TABLE: Optional[np.ndarray] = None
@@ -147,42 +157,50 @@ def base_table() -> np.ndarray:
 
 def base_table_device() -> jnp.ndarray:
     """Device-resident copy of base_table() (uploaded once — the verify
-    hot path must not re-transfer 200 KB per batch)."""
+    hot path must not re-transfer 256 KB per batch)."""
     global _BASE_TABLE_DEV
     if _BASE_TABLE_DEV is None:
         _BASE_TABLE_DEV = jnp.asarray(base_table())
     return _BASE_TABLE_DEV
 
 
-def negate_niels(t: jnp.ndarray) -> jnp.ndarray:
-    """Niels negation: swap (y+x, y−x), negate 2dxy. Shape (..., 3, 17)."""
-    return jnp.stack(
-        [t[..., 1, :], t[..., 0, :], fe.neg(t[..., 2, :])], axis=-2
-    )
-
-
 def nibbles_np(le_bytes: np.ndarray) -> np.ndarray:
     """(n, 32) uint8 little-endian scalar -> (n, 64) int32 nibbles, least
     significant first (position i carries weight 16^i — matching
-    comb_table_np, order-free since the comb has no doublings)."""
+    comb_table_np, order-free since the comb has no doublings). Callers
+    transpose to the device's (NPOS, B) position-major layout."""
     lo = le_bytes & 0x0F
     hi = le_bytes >> 4
     return np.stack([lo, hi], axis=-1).reshape(le_bytes.shape[0], 64).astype(np.int32)
 
 
 # ---------------------------------------------------------------------------
-# Device kernel pieces
+# Device kernel pieces (limb-major, batch-minor)
 # ---------------------------------------------------------------------------
 
 
-def madd(p: jnp.ndarray, q_niels: jnp.ndarray) -> jnp.ndarray:
-    """Mixed add: extended (..., 4, 17) + affine Niels (..., 3, 17).
+def _row_niels(rows: jnp.ndarray):
+    """Packed rows (ROW, ...) -> (ypx, ymx, xy2d) limb arrays (17, ...)."""
+    n = fe.NLIMB
+    return rows[:n], rows[n : 2 * n], rows[2 * n : 3 * n]
+
+
+def negate_rows(rows: jnp.ndarray) -> jnp.ndarray:
+    """Niels negation on packed rows: swap (y+x, y−x), negate 2dxy."""
+    ypx, ymx, xy2d = _row_niels(rows)
+    return jnp.concatenate(
+        [ymx, ypx, fe.neg(xy2d), rows[3 * fe.NLIMB :]], axis=0
+    )
+
+
+def madd(p: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
+    """Mixed add: extended (4, 17, ...) + packed Niels rows (ROW, ...).
 
     ref10-style ge_madd — 7 field muls. Same group law as
     edwards.point_add with Z2 = 1 and the Niels components precomputed.
     """
-    x1, y1, z1, t1 = (p[..., i, :] for i in range(4))
-    ypx, ymx, xy2d = (q_niels[..., i, :] for i in range(3))
+    x1, y1, z1, t1 = p[0], p[1], p[2], p[3]
+    ypx, ymx, xy2d = _row_niels(rows)
     a = fe.mul(fe.add(y1, x1), ypx)
     b = fe.mul(fe.sub(y1, x1), ymx)
     c = fe.mul(xy2d, t1)
@@ -192,39 +210,8 @@ def madd(p: jnp.ndarray, q_niels: jnp.ndarray) -> jnp.ndarray:
     g = fe.add(d, c)
     h = fe.add(a, b)
     return jnp.stack(
-        [fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h)], axis=-2
+        [fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h)], axis=0
     )
-
-
-def comb_accumulate(
-    s_nibbles: jnp.ndarray,
-    k_nibbles: jnp.ndarray,
-    a_row_base: jnp.ndarray,
-    a_flat: jnp.ndarray,
-    b_flat: jnp.ndarray,
-) -> jnp.ndarray:
-    """[S]B + [k](−A) via comb tables: one fori_loop over the 64 nibble
-    positions, gathering each position's Niels entries on the fly (keeps
-    device memory O(B), not O(B * NPOS)) and applying two mixed adds.
-
-    s_nibbles, k_nibbles: (B, NPOS) int32. a_row_base: (B,) int32 =
-    key_index * NPOS * WINDOW. a_flat: (n_keys*NPOS*WINDOW, 3, 17).
-    b_flat: (NPOS*WINDOW, 3, 17).
-    """
-    batch = s_nibbles.shape[0]
-    ident = jnp.broadcast_to(jnp.asarray(ref_identity_limbs()), (batch, 4, 17))
-    # inherit varying manual axes from the data under shard_map
-    ident = ident + (s_nibbles[:, :1, None] * 0)
-
-    def body(i, acc):
-        sel_b = jnp.take(b_flat, i * WINDOW + s_nibbles[:, i], axis=0)
-        sel_a = jnp.take(
-            a_flat, a_row_base + i * WINDOW + k_nibbles[:, i], axis=0
-        )
-        acc = madd(acc, sel_b)
-        return madd(acc, negate_niels(sel_a))
-
-    return lax.fori_loop(0, NPOS, body, ident)
 
 
 _IDENT_LIMBS: Optional[np.ndarray] = None
@@ -239,31 +226,51 @@ def ref_identity_limbs() -> np.ndarray:
     return _IDENT_LIMBS
 
 
-def _interleave(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """(m, 17), (m, 17) -> (2m, 17) alternating a0 b0 a1 b1 ..."""
-    return jnp.stack([a, b], axis=1).reshape(-1, a.shape[-1])
+def _ident_like(batch_ref: jnp.ndarray) -> jnp.ndarray:
+    """(4, 17, B) identity accumulator. Derived from a batch-varying array
+    (not a broadcast constant) so the loop carry inherits the data's
+    varying manual axes under shard_map."""
+    ident = jnp.asarray(ref_identity_limbs())[:, :, None]  # (4, 17, 1)
+    return ident + (batch_ref * 0)[None, None]
 
 
-def batch_invert(z: jnp.ndarray) -> jnp.ndarray:
-    """Tree-structured Montgomery batch inversion: (B, 17) -> (B, 17).
+def _gather_rows(flat_table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """One flat fetch of every position's packed row, staged position-major.
 
-    Pairwise products up the tree (log2 B batched muls totalling ≈ B
-    multiplies), ONE scalar invert chain at the root, then unfold back
-    down (≈ 2B multiplies). Requires B a power of two and all inputs
-    nonzero — guaranteed for Z coordinates of complete Edwards formulas.
+    flat_table: (M, ROW). idx: (NPOS, B) row indices. -> (NPOS, ROW, B).
+    A single big `take` keeps the gather dense (the per-position-in-loop
+    form is ~20x slower on TPU); the transpose to batch-minor happens once
+    here, not per position.
     """
-    n = z.shape[0]
-    assert n & (n - 1) == 0, "batch_invert requires a power-of-two batch"
-    levels = []
-    cur = z
-    while cur.shape[0] > 1:
-        levels.append(cur)
-        cur = fe.mul(cur[0::2], cur[1::2])
-    inv = fe.invert(cur)  # (1, 17) — the only exponentiation chain
-    for lev in reversed(levels):
-        left, right = lev[0::2], lev[1::2]
-        inv = _interleave(fe.mul(inv, right), fe.mul(inv, left))
-    return inv
+    npos, b = idx.shape
+    rows = jnp.take(flat_table, idx.reshape(-1), axis=0)  # (NPOS*B, ROW)
+    return rows.reshape(npos, b, ROW).transpose(0, 2, 1)
+
+
+def comb_accumulate(
+    s_nibbles: jnp.ndarray,
+    k_nibbles: jnp.ndarray,
+    a_row_base: jnp.ndarray,
+    a_flat: jnp.ndarray,
+    b_flat: jnp.ndarray,
+) -> jnp.ndarray:
+    """[S]B + [k](−A) via separate comb tables: two row fetches + two
+    mixed adds per nibble position (128 madds total).
+
+    s_nibbles, k_nibbles: (NPOS, B) int32. a_row_base: (B,) int32 =
+    key_index * NPOS * WINDOW. a_flat: (n_keys*NPOS*WINDOW, ROW).
+    b_flat: (NPOS*WINDOW, ROW).
+    """
+    pos = jnp.arange(NPOS, dtype=jnp.int32)[:, None]
+    b_rows = _gather_rows(b_flat, pos * WINDOW + s_nibbles)
+    a_rows = _gather_rows(a_flat, a_row_base[None, :] + pos * WINDOW + k_nibbles)
+    acc0 = _ident_like(s_nibbles[0])
+
+    def body(i, acc):
+        acc = madd(acc, b_rows[i])
+        return madd(acc, negate_rows(a_rows[i]))
+
+    return lax.fori_loop(0, NPOS, body, acc0)
 
 
 def fused_accumulate(
@@ -272,65 +279,88 @@ def fused_accumulate(
     row_base: jnp.ndarray,
     f_flat: jnp.ndarray,
 ) -> jnp.ndarray:
-    """[S]B + [k](−A) via the fused dual-scalar table: one gather + one
+    """[S]B + [k](−A) via the fused dual-scalar table: one row fetch + one
     mixed add per nibble position (64 total).
 
-    s_nibbles, k_nibbles: (B, NPOS) int32. row_base: (B,) int32 =
-    key_index * NPOS * FWINDOW. f_flat: (n_keys*NPOS*FWINDOW, 3, 17).
+    s_nibbles, k_nibbles: (NPOS, B) int32. row_base: (B,) int32 =
+    key_index * NPOS * FWINDOW. f_flat: (n_keys*NPOS*FWINDOW, ROW).
     """
-    batch = s_nibbles.shape[0]
-    ident = jnp.broadcast_to(jnp.asarray(ref_identity_limbs()), (batch, 4, 17))
-    # inherit varying manual axes from the data under shard_map
-    ident = ident + (s_nibbles[:, :1, None] * 0)
+    pos = jnp.arange(NPOS, dtype=jnp.int32)[:, None]
+    idx = row_base[None, :] + pos * FWINDOW + s_nibbles * WINDOW + k_nibbles
+    rows_all = _gather_rows(f_flat, idx)  # (NPOS, ROW, B)
+    acc0 = _ident_like(s_nibbles[0])
 
     def body(i, acc):
-        idx = row_base + i * FWINDOW + s_nibbles[:, i] * WINDOW + k_nibbles[:, i]
-        return madd(acc, jnp.take(f_flat, idx, axis=0))
+        return madd(acc, rows_all[i])
 
-    return lax.fori_loop(0, NPOS, body, ident)
+    return lax.fori_loop(0, NPOS, body, acc0)
 
 
-def fused_verify_kernel(
-    s_nibbles: jnp.ndarray,  # (B, 64) int32 — S scalar nibbles
-    k_nibbles: jnp.ndarray,  # (B, 64) int32 — challenge scalar nibbles
-    a_index: jnp.ndarray,  # (B,) int32 — row into the fused table bank
-    f_tables: jnp.ndarray,  # (n_keys, NPOS, FWINDOW, 3, 17) int32 Niels
-    r_y: jnp.ndarray,  # (B, 17) int32 — R's canonical y limbs
-    r_sign: jnp.ndarray,  # (B,) int32 — R's x sign bit
-    precheck: jnp.ndarray,  # (B,) bool — host-side validity mask
+def _interleave(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(17, m), (17, m) -> (17, 2m) alternating a0 b0 a1 b1 ..."""
+    return jnp.stack([a, b], axis=2).reshape(a.shape[0], -1)
+
+
+def batch_invert(z: jnp.ndarray) -> jnp.ndarray:
+    """Tree-structured Montgomery batch inversion: (17, B) -> (17, B).
+
+    Pairwise products up the tree (log2 B batched muls totalling ≈ B
+    multiplies), ONE scalar invert chain at the root, then unfold back
+    down (≈ 2B multiplies). Requires B a power of two and all inputs
+    nonzero — guaranteed for Z coordinates of complete Edwards formulas.
+    """
+    n = z.shape[1]
+    assert n & (n - 1) == 0, "batch_invert requires a power-of-two batch"
+    levels = []
+    cur = z
+    while cur.shape[1] > 1:
+        levels.append(cur)
+        cur = fe.mul(cur[:, 0::2], cur[:, 1::2])
+    inv = fe.invert(cur)  # (17, 1) — the only exponentiation chain
+    for lev in reversed(levels):
+        left, right = lev[:, 0::2], lev[:, 1::2]
+        inv = _interleave(fe.mul(inv, right), fe.mul(inv, left))
+    return inv
+
+
+def _encode_and_compare(
+    p: jnp.ndarray, r_y: jnp.ndarray, r_sign: jnp.ndarray, precheck: jnp.ndarray
 ) -> jnp.ndarray:
-    """Batched verify via the fused comb: 64 gathers + 64 madds per row."""
-    nk = f_tables.shape[0]
-    f_flat = f_tables.reshape(nk * NPOS * FWINDOW, 3, 17)
-    p = fused_accumulate(
-        s_nibbles, k_nibbles, a_index * (NPOS * FWINDOW), f_flat
-    )
-    zinv = batch_invert(p[..., 2, :])
-    x_aff = fe.mul(p[..., 0, :], zinv)
-    y_aff = fe.mul(p[..., 1, :], zinv)
+    """Affine-normalize the accumulator (batch inversion) and compare its
+    canonical encoding against R's wire bytes."""
+    zinv = batch_invert(p[2])
+    x_aff = fe.mul(p[0], zinv)
+    y_aff = fe.mul(p[1], zinv)
     ok = fe.eq(y_aff, r_y) & (fe.parity(x_aff) == r_sign)
     return ok & precheck
 
 
+def fused_verify_kernel(
+    s_nibbles: jnp.ndarray,  # (NPOS, B) int32 — S scalar nibbles
+    k_nibbles: jnp.ndarray,  # (NPOS, B) int32 — challenge scalar nibbles
+    a_index: jnp.ndarray,  # (B,) int32 — key row into the fused table bank
+    f_table: jnp.ndarray,  # (n_keys*NPOS*FWINDOW, ROW) packed Niels rows
+    r_y: jnp.ndarray,  # (17, B) int32 — R's canonical y limbs
+    r_sign: jnp.ndarray,  # (B,) int32 — R's x sign bit
+    precheck: jnp.ndarray,  # (B,) bool — host-side validity mask
+) -> jnp.ndarray:
+    """Batched verify via the fused comb: 64 row fetches + 64 madds/row."""
+    p = fused_accumulate(s_nibbles, k_nibbles, a_index * (NPOS * FWINDOW), f_table)
+    return _encode_and_compare(p, r_y, r_sign, precheck)
+
+
 def comb_verify_kernel(
-    s_nibbles: jnp.ndarray,  # (B, 64) int32 — S scalar nibbles
-    k_nibbles: jnp.ndarray,  # (B, 64) int32 — challenge scalar nibbles
-    a_index: jnp.ndarray,  # (B,) int32 — row into the pubkey table bank
-    a_tables: jnp.ndarray,  # (n_keys, NPOS, WINDOW, 3, 17) int32 Niels
-    b_table: jnp.ndarray,  # (NPOS, WINDOW, 3, 17) int32 Niels (base point)
-    r_y: jnp.ndarray,  # (B, 17) int32 — R's canonical y limbs
+    s_nibbles: jnp.ndarray,  # (NPOS, B) int32 — S scalar nibbles
+    k_nibbles: jnp.ndarray,  # (NPOS, B) int32 — challenge scalar nibbles
+    a_index: jnp.ndarray,  # (B,) int32 — key row into the pubkey table bank
+    a_table: jnp.ndarray,  # (n_keys*NPOS*WINDOW, ROW) packed Niels rows
+    b_table: jnp.ndarray,  # (NPOS*WINDOW, ROW) packed rows (base point)
+    r_y: jnp.ndarray,  # (17, B) int32 — R's canonical y limbs
     r_sign: jnp.ndarray,  # (B,) int32 — R's x sign bit
     precheck: jnp.ndarray,  # (B,) bool — host-side validity mask
 ) -> jnp.ndarray:
     """Batched verify via combs: [S]B + [k](−A) must encode to R's bytes."""
-    b_flat = b_table.reshape(NPOS * WINDOW, 3, 17)
-    nk = a_tables.shape[0]
-    a_flat = a_tables.reshape(nk * NPOS * WINDOW, 3, 17)
     p = comb_accumulate(
-        s_nibbles, k_nibbles, a_index * (NPOS * WINDOW), a_flat, b_flat
+        s_nibbles, k_nibbles, a_index * (NPOS * WINDOW), a_table, b_table
     )
-    zinv = batch_invert(p[..., 2, :])
-    x_aff = fe.mul(p[..., 0, :], zinv)
-    y_aff = fe.mul(p[..., 1, :], zinv)
-    ok = fe.eq(y_aff, r_y) & (fe.parity(x_aff) == r_sign)
-    return ok & precheck
+    return _encode_and_compare(p, r_y, r_sign, precheck)

@@ -1,10 +1,13 @@
 """Edwards25519 point arithmetic on TPU (extended coordinates, a = -1).
 
-Points are int32 arrays of shape (..., 4, 17): stacked (X, Y, Z, T) limb
-vectors with x = X/Z, y = Y/Z, T = XY/Z. The stacked layout makes
-constant-shape table selection (jnp.where over a (k, 4, 17) table) and
-vmap over batches trivial — the design constraint is XLA: no data-dependent
-control flow, every verify is the same fixed ladder.
+Points are int32 arrays of shape (4, 17, ...): stacked (X, Y, Z, T) limb
+vectors with x = X/Z, y = Y/Z, T = XY/Z. Like the field layer
+(ops/field25519.py), the limb axis leads and batch axes trail so the batch
+fills the 128-wide vector lanes — the layout that makes the fixed ladder
+VPU-dense instead of HBM-bound. The stacked layout keeps constant-shape
+table selection (jnp.where over a (k, 4, 17, ...) table) trivial — the
+design constraint is XLA: no data-dependent control flow, every verify is
+the same fixed ladder.
 
 Formulas: unified add-2008-hwcd-3 and dbl-2008-hwcd (same formulas the CPU
 oracle in crypto/ed25519_cpu.py uses, so both planes agree bit-for-bit).
@@ -40,15 +43,20 @@ IDENTITY = _point_const(ref.IDENTITY)  # (4, 17)
 BASE = _point_const(ref.B)
 
 
+def _pconst(c: np.ndarray, like: jnp.ndarray) -> jnp.ndarray:
+    """(4, 17) point constant -> broadcastable against (4, 17, ...)."""
+    return jnp.asarray(c).reshape((4, fe.NLIMB) + (1,) * (like.ndim - 2))
+
+
 # -- coordinate accessors ---------------------------------------------------
 
 
 def _unpack(p: jnp.ndarray):
-    return p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
+    return p[0], p[1], p[2], p[3]
 
 
 def _pack(x, y, z, t) -> jnp.ndarray:
-    return jnp.stack([x, y, z, t], axis=-2)
+    return jnp.stack([x, y, z, t], axis=0)
 
 
 # -- group law --------------------------------------------------------------
@@ -60,7 +68,7 @@ def point_add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
     x2, y2, z2, t2 = _unpack(q)
     a = fe.mul(fe.sub(y1, x1), fe.sub(y2, x2))
     b = fe.mul(fe.add(y1, x1), fe.add(y2, x2))
-    c = fe.mul(fe.mul(t1, jnp.asarray(D2_LIMBS)), t2)
+    c = fe.mul(fe.mul(t1, fe.bcast(D2_LIMBS, t1)), t2)
     d = fe.mul_small(fe.mul(z1, z2), 2)
     e = fe.sub(b, a)
     f = fe.sub(d, c)
@@ -89,12 +97,12 @@ def point_neg(p: jnp.ndarray) -> jnp.ndarray:
 
 
 def point_select(idx: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
-    """table[idx] with constant shape: table (..., k, 4, 17), idx (...,).
+    """table[idx] with constant shape: table (k, 4, 17, ...), idx (...,).
     A where-chain (not gather) so XLA vectorizes it across the batch."""
-    k = table.shape[-3]
-    out = table[..., 0, :, :]
+    k = table.shape[0]
+    out = table[0]
     for i in range(1, k):
-        out = jnp.where((idx == i)[..., None, None], table[..., i, :, :], out)
+        out = jnp.where((idx == i)[None, None], table[i], out)
     return out
 
 
@@ -106,20 +114,20 @@ def double_scalar_mul_base(
 ) -> jnp.ndarray:
     """[s]B + [k]Q via interleaved Straus ladder.
 
-    s_bits, k_bits: (..., 256) int32 bits, MSB first. q: (..., 4, 17).
+    s_bits, k_bits: (256, ...) int32 bits, MSB first. q: (4, 17, ...).
     One shared doubling per bit; the per-bit addend is selected from the
     4-entry table {identity, B, Q, B+Q} by the bit pair. 256 uniform
     iterations — constant shape, no data-dependent control flow.
     """
-    base = jnp.broadcast_to(jnp.asarray(BASE), q.shape)
+    base = jnp.broadcast_to(_pconst(BASE, q), q.shape)
     # derive from q (not broadcast a constant) so the loop carry inherits
     # q's varying manual axes under shard_map
-    ident = q * 0 + jnp.asarray(IDENTITY)
-    table = jnp.stack([ident, base, q, point_add(base, q)], axis=-3)
+    ident = q * 0 + _pconst(IDENTITY, q)
+    table = jnp.stack([ident, base, q, point_add(base, q)], axis=0)
 
     def body(i, acc):
         acc = point_double(acc)
-        idx = s_bits[..., i] + 2 * k_bits[..., i]
+        idx = s_bits[i] + 2 * k_bits[i]
         addend = point_select(idx, table)
         return point_add(acc, addend)
 
@@ -130,7 +138,7 @@ def double_scalar_mul_base(
 
 
 def compress(p: jnp.ndarray):
-    """-> (y_limbs canonical (..., 17), x_parity (...,)) — the wire form is
+    """-> (y_limbs canonical (17, ...), x_parity (...,)) — the wire form is
     y with the sign bit of x in bit 255 (RFC 8032 §5.1.2)."""
     x, y, z, _ = _unpack(p)
     zinv = fe.invert(z)
@@ -140,7 +148,7 @@ def compress(p: jnp.ndarray):
 
 
 def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray):
-    """Recover (..., 4, 17) extended point from canonical y and sign bit.
+    """Recover (4, 17, ...) extended point from canonical y and sign bit.
 
     RFC 8032 §5.1.3: x^2 = (y^2-1)/(d y^2+1); the square root and the
     inversion share one exponentiation: x = u v^3 (u v^7)^((p-5)/8).
@@ -148,23 +156,24 @@ def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray):
     x = 0 with sign = 1. Mirrors ed25519_cpu._recover_x (callers must
     ensure y < p — host-side canonicality check).
     """
+    one = fe.bcast(fe.ONE, y_limbs)
     yy = fe.sq(y_limbs)
-    u = fe.sub(yy, jnp.asarray(fe.ONE))  # y^2 - 1
-    v = fe.add(fe.mul(yy, jnp.asarray(D_LIMBS)), jnp.asarray(fe.ONE))
+    u = fe.sub(yy, one)  # y^2 - 1
+    v = fe.add(fe.mul(yy, fe.bcast(D_LIMBS, yy)), one)
     v3 = fe.mul(fe.sq(v), v)
     v7 = fe.mul(fe.sq(v3), v)
     x = fe.mul(fe.mul(u, v3), fe.pow22523(fe.mul(u, v7)))
     vxx = fe.mul(v, fe.sq(x))
     ok_direct = fe.eq(vxx, u)
     ok_twist = fe.eq(vxx, fe.neg(u))
-    x = fe.select(ok_twist, fe.mul(x, jnp.asarray(SQRT_M1)), x)
+    x = fe.select(ok_twist, fe.mul(x, fe.bcast(SQRT_M1, x)), x)
     ok = ok_direct | ok_twist
     x = fe.to_canonical(x)
     x_is_zero = fe.is_zero(x)
     ok = ok & ~(x_is_zero & (sign == 1))
     # match the requested sign
-    flip = (x[..., 0] & 1) != sign
+    flip = (x[0] & 1) != sign
     x = fe.select(flip, fe.neg(x), x)
     t = fe.mul(x, y_limbs)
-    z = jnp.broadcast_to(jnp.asarray(fe.ONE), y_limbs.shape)
+    z = jnp.broadcast_to(one, y_limbs.shape)
     return _pack(x, y_limbs, z, t), ok

@@ -44,27 +44,30 @@ from ..ops import comb
 def make_comb_quorum_step(mesh: Mesh, axis: str = "dp"):
     """Build the jitted SPMD step for the comb engine (the fast path).
 
-    Returns step(s_nib, k_nib, a_idx, a_tables, b_table, r_y, r_sign,
+    Returns step(s_nib, k_nib, a_idx, a_table, b_table, r_y, r_sign,
                  precheck, inst_onehot) -> (verdict (B,) bool dp-sharded,
                                             counts (n_inst,) replicated)
 
-    Per-item arrays shard over `axis`; the comb table banks replicate
-    (they are the committee's keys — small and read-only, so replication
-    costs HBM, not ICI). The quorum tally is the only cross-chip traffic:
-    one psum of an (n_instances,) int32 vector.
+    Per-item arrays shard over `axis` — their batch dimension is TRAILING
+    (limb/position-major layout, see ops/field25519.py), so 2-D arrays
+    use P(None, axis). The packed comb table banks replicate (they are
+    the committee's keys — small and read-only, so replication costs HBM,
+    not ICI). The quorum tally is the only cross-chip traffic: one psum
+    of an (n_instances,) int32 vector.
     """
-    data = P(axis)
+    vec = P(axis)  # (B,)
+    mat = P(None, axis)  # (pos/limb, B)
     repl = P()
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(data, data, data, repl, repl, data, data, data, data),
-        out_specs=(data, repl),
+        in_specs=(mat, mat, vec, repl, repl, mat, vec, vec, P(axis, None)),
+        out_specs=(vec, repl),
     )
-    def _step(s_nib, k_nib, a_idx, a_tables, b_table, r_y, r_sign, precheck, onehot):
+    def _step(s_nib, k_nib, a_idx, a_table, b_table, r_y, r_sign, precheck, onehot):
         verdict = comb.comb_verify_kernel(
-            s_nib, k_nib, a_idx, a_tables, b_table, r_y, r_sign, precheck
+            s_nib, k_nib, a_idx, a_table, b_table, r_y, r_sign, precheck
         )
         local = jnp.sum(onehot * verdict[:, None].astype(jnp.int32), axis=0)
         counts = jax.lax.psum(local, axis)
@@ -81,16 +84,18 @@ def make_quorum_step(mesh: Mesh, axis: str = "dp"):
                                   counts (n_instances,) int32 replicated)
 
     where inst_onehot is (B, n_instances) int32 mapping each vote to its
-    consensus instance (all-zero rows = padding).
+    consensus instance (all-zero rows = padding). Limb/bit-major arrays
+    (a_y, r_y, s_bits, k_bits) have the batch axis trailing.
     """
-    data = P(axis)
+    vec = P(axis)
+    mat = P(None, axis)
     repl = P()
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(data,) * 7 + (data,),
-        out_specs=(data, repl),
+        in_specs=(mat, vec, mat, vec, mat, mat, vec, P(axis, None)),
+        out_specs=(vec, repl),
     )
     def _step(a_y, a_sign, r_y, r_sign, s_bits, k_bits, precheck, inst_onehot):
         verdict = verify_kernel(a_y, a_sign, r_y, r_sign, s_bits, k_bits, precheck)

@@ -4,6 +4,9 @@ The jnp limb arithmetic (ops/field25519.py, ops/edwards.py) must agree with
 Python bignum math on every operation — these are known-answer tests over
 random and adversarial (boundary) inputs, run on the 8-virtual-device CPU
 backend (conftest.py) exactly as they jit on TPU.
+
+Device layout convention: limb axis FIRST, batch axes trailing — a batch
+of field elements is (17, n), a batch of points (4, 17, n).
 """
 
 import random
@@ -27,6 +30,11 @@ def limbs(v: int) -> jnp.ndarray:
     return jnp.asarray(fe._int_to_limbs_np(v % P))
 
 
+def limb_batch(vals) -> jnp.ndarray:
+    """ints -> (17, n) limb-first batch."""
+    return jnp.asarray(np.stack([fe._int_to_limbs_np(v % P) for v in vals], axis=1))
+
+
 def unlimbs(a) -> int:
     return fe._limbs_to_int_np(np.asarray(a))
 
@@ -42,40 +50,47 @@ class TestFieldOps:
 
     def test_add_sub_mul(self):
         vals = BOUNDARY + rand_elems(30)
-        a_np = np.stack([fe._int_to_limbs_np(v) for v in vals])
         b_vals = list(reversed(vals))
-        b_np = np.stack([fe._int_to_limbs_np(v) for v in b_vals])
-        a, b = jnp.asarray(a_np), jnp.asarray(b_np)
+        a, b = limb_batch(vals), limb_batch(b_vals)
         add = jax.jit(fe.add)(a, b)
         sub = jax.jit(fe.sub)(a, b)
         mul = jax.jit(fe.mul)(a, b)
         for i, (x, y) in enumerate(zip(vals, b_vals)):
-            assert unlimbs(fe.to_canonical(add[i])) == (x + y) % P
-            assert unlimbs(fe.to_canonical(sub[i])) == (x - y) % P
-            assert unlimbs(fe.to_canonical(mul[i])) == (x * y) % P
+            assert unlimbs(fe.to_canonical(add[:, i])) == (x + y) % P
+            assert unlimbs(fe.to_canonical(sub[:, i])) == (x - y) % P
+            assert unlimbs(fe.to_canonical(mul[:, i])) == (x * y) % P
+
+    def test_mul_impls_agree(self):
+        vals = BOUNDARY + rand_elems(10)
+        a, b = limb_batch(vals), limb_batch(list(reversed(vals)))
+        skew = jax.jit(fe.mul_skew)(a, b)
+        padacc = jax.jit(fe.mul_padacc)(a, b)
+        for i in range(len(vals)):
+            assert unlimbs(fe.to_canonical(skew[:, i])) == unlimbs(
+                fe.to_canonical(padacc[:, i])
+            )
 
     def test_mul_worst_case_limbs(self):
         # all-ones limbs (maximum column sums) must not overflow int32
-        v = (1 << 255) - 1  # every 15-bit limb at maximum
-        a = limbs(v % P)
         top = jnp.asarray(np.full(fe.NLIMB, fe.MASK, dtype=np.int32))
-        got = fe.to_canonical(fe.mul(top, top))
-        assert unlimbs(got) == (((1 << 255) - 1) ** 2) % P
+        for mul in (fe.mul_padacc, fe.mul_skew):
+            got = fe.to_canonical(mul(top, top))
+            assert unlimbs(got) == (((1 << 255) - 1) ** 2) % P
 
     def test_invert(self):
         vals = [0, 1, 2, P - 1] + rand_elems(5)
-        batch = jnp.stack([limbs(v) for v in vals])
+        batch = limb_batch(vals)
         out = jax.jit(fe.invert)(batch)
         for i, v in enumerate(vals):
             want = pow(v, P - 2, P) if v else 0
-            assert unlimbs(fe.to_canonical(out[i])) == want
+            assert unlimbs(fe.to_canonical(out[:, i])) == want
 
     def test_pow22523(self):
         vals = [1, 2] + rand_elems(5)
-        batch = jnp.stack([limbs(v) for v in vals])
+        batch = limb_batch(vals)
         out = jax.jit(fe.pow22523)(batch)
         for i, v in enumerate(vals):
-            assert unlimbs(fe.to_canonical(out[i])) == pow(v, (P - 5) // 8, P)
+            assert unlimbs(fe.to_canonical(out[:, i])) == pow(v, (P - 5) // 8, P)
 
     def test_eq_parity_zero(self):
         a = limbs(5)
@@ -91,8 +106,13 @@ def pt(p_int):
     return jnp.asarray(ed._point_const(p_int))
 
 
+def pt_batch(pts):
+    """points -> (4, 17, n)."""
+    return jnp.asarray(np.stack([ed._point_const(p) for p in pts], axis=-1))
+
+
 def affine(p) -> tuple:
-    x, y, z, t = [unlimbs(fe.to_canonical(p[..., i, :])) for i in range(4)]
+    x, y, z, t = [unlimbs(fe.to_canonical(p[i])) for i in range(4)]
     zi = pow(z, P - 2, P)
     return (x * zi % P, y * zi % P)
 
@@ -120,44 +140,44 @@ class TestPointOps:
         assert affine(ed.point_add(pt(p_ref), ed.point_neg(pt(p_ref)))) == (0, 1)
 
     def test_double_scalar_mul(self):
-        # batched (leading dim 3): one compile covers all cases
-        qs, ss, ks = [], [], []
+        # batched (trailing dim 3): one compile covers all cases
+        qs = []
         for _ in range(3):
             q_ref, _ = self.rand_point()
             qs.append((q_ref, rng.randrange(ref.L), rng.randrange(ref.L)))
-        q_arr = jnp.stack([pt(q) for q, _, _ in qs])
+        q_arr = pt_batch([q for q, _, _ in qs])
         s_bits = jnp.asarray(
-            [[(s >> (255 - i)) & 1 for i in range(256)] for _, s, _ in qs],
+            [[(s >> (255 - i)) & 1 for _, s, _ in qs] for i in range(256)],
             dtype=jnp.int32,
-        )
+        )  # (256, 3) — bit axis leading
         k_bits = jnp.asarray(
-            [[(k >> (255 - i)) & 1 for i in range(256)] for _, _, k in qs],
+            [[(k >> (255 - i)) & 1 for _, _, k in qs] for i in range(256)],
             dtype=jnp.int32,
         )
         got = jax.jit(ed.double_scalar_mul_base)(s_bits, k_bits, q_arr)
         for i, (q_ref, s, k) in enumerate(qs):
             want = ref.point_add(ref.point_mul(s, ref.B), ref.point_mul(k, q_ref))
-            assert affine(got[i]) == ref.point_to_affine(want)
+            assert affine(got[:, :, i]) == ref.point_to_affine(want)
 
     def test_compress_decompress_roundtrip(self):
         pts = [self.rand_point()[0] for _ in range(4)]
         wires = np.stack(
             [np.frombuffer(ref.point_compress(p), dtype=np.uint8) for p in pts]
         )
-        y_limbs = jnp.asarray(fe.bytes32_to_limbs_np(wires))
+        y_limbs = jnp.asarray(fe.bytes32_to_limbs_np(wires).T)  # (17, n)
         sign = jnp.asarray(fe.sign_bits_np(wires))
         point, ok = jax.jit(ed.decompress)(y_limbs, sign)
         y_out, x_par = jax.jit(ed.compress)(point)
         for i, p_ref in enumerate(pts):
             enc = int.from_bytes(wires[i].tobytes(), "little")
             assert bool(ok[i])
-            assert affine(point[i]) == ref.point_to_affine(p_ref)
-            assert unlimbs(y_out[i]) == enc & ((1 << 255) - 1)
+            assert affine(point[:, :, i]) == ref.point_to_affine(p_ref)
+            assert unlimbs(y_out[:, i]) == enc & ((1 << 255) - 1)
             assert int(x_par[i]) == enc >> 255
 
     def test_decompress_invalid(self):
         ys = list(range(2, 14))
-        y_arr = jnp.stack([limbs(y) for y in ys])
+        y_arr = limb_batch(ys)
         zero_sign = jnp.zeros(len(ys), dtype=jnp.int32)
         _, ok = jax.jit(ed.decompress)(y_arr, zero_sign)
         flags = [ref._recover_x(y, 0) is not None for y in ys]
@@ -167,7 +187,7 @@ class TestPointOps:
 
     def test_decompress_zero_x_sign(self):
         # y = 1 -> x = 0; sign bit 1 must be rejected (non-canonical)
-        y_arr = jnp.stack([limbs(1), limbs(1)])
+        y_arr = limb_batch([1, 1])
         signs = jnp.asarray([1, 0], dtype=jnp.int32)
         _, ok = jax.jit(ed.decompress)(y_arr, signs)
         assert not bool(ok[0])

@@ -1,0 +1,81 @@
+"""Native host-prep library (simple_pbft_tpu/native) vs Python oracles.
+
+The C++ SHA-512 and sc_reduce must agree with hashlib / the pure-Python
+RFC 8032 implementation on every input shape that matters: empty
+messages, single-block, exact padding boundaries (111/112/128 bytes),
+multi-block, and large buffers. If the toolchain is unavailable the
+library falls back to Python — these tests then exercise the fallback.
+"""
+
+import hashlib
+
+import numpy as np
+
+from simple_pbft_tpu import native
+from simple_pbft_tpu.crypto import ed25519_cpu as ref
+
+# message lengths crossing all SHA-512 padding boundaries for the
+# 64-byte (R||A) prefix: total = 64 + n, block = 128, len-field at 112
+EDGE_LENS = [0, 1, 47, 48, 49, 63, 64, 65, 111, 112, 127, 128, 129, 1000, 5000]
+
+
+def test_sha512_batch_matches_hashlib():
+    rng = np.random.default_rng(7)
+    msgs = [rng.integers(0, 256, n, dtype=np.uint8).tobytes() for n in EDGE_LENS]
+    got = native.sha512_batch(msgs)
+    for i, m in enumerate(msgs):
+        assert got[i].tobytes() == hashlib.sha512(m).digest(), f"len {len(m)}"
+
+
+def test_challenge_batch_matches_oracle():
+    rng = np.random.default_rng(8)
+    n = len(EDGE_LENS)
+    r = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+    a = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+    msgs = [rng.integers(0, 256, ln, dtype=np.uint8).tobytes() for ln in EDGE_LENS]
+    got = native.challenge_batch(r, a, msgs)
+    for i in range(n):
+        want = ref.challenge_scalar(r[i].tobytes(), a[i].tobytes(), msgs[i])
+        assert got[i].tobytes() == want.to_bytes(32, "little"), f"row {i}"
+
+
+def test_challenge_batch_random_bulk():
+    rng = np.random.default_rng(9)
+    n = 256
+    r = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+    a = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+    msgs = [b"x" * int(i % 7) for i in range(n)]
+    got = native.challenge_batch(r, a, msgs)
+    for i in range(n):
+        want = ref.challenge_scalar(r[i].tobytes(), a[i].tobytes(), msgs[i])
+        assert int.from_bytes(got[i].tobytes(), "little") == want
+
+
+def test_sc_reduce_boundary_values():
+    """The signed-fold reduction's edge cases, driven directly: zero, the
+    sign-flip magnitudes, values straddling L, 2^252, 2^253 and the
+    512-bit top — each compared against Python bigint mod."""
+    L = ref.L
+    cases = [
+        0, 1, 2, L - 1, L, L + 1, 2 * L, 2 * L - 1,
+        2**252 - 1, 2**252, 2**252 + 1, 2**253 - 1, 2**253, 2**253 + 1,
+        (2**512 - 1) // L * L,          # largest multiple of L in range
+        (2**512 - 1) // L * L - 1,
+        2**512 - 1, 2**511, 2**256 - 1, 2**256, 2**384 - 1,
+        17 * L + 5, (2**260) * L % (2**512),
+    ]
+    rng = np.random.default_rng(11)
+    cases += [int(rng.integers(0, 2**63)) * L for _ in range(8)]  # exact multiples
+    digests = np.stack(
+        [np.frombuffer(v.to_bytes(64, "little"), np.uint8) for v in cases]
+    )
+    got = native.sc_reduce_batch(digests)
+    for i, v in enumerate(cases):
+        assert int.from_bytes(got[i].tobytes(), "little") == v % L, f"case {i}: {v}"
+
+
+def test_empty_batch():
+    assert native.challenge_batch(
+        np.zeros((0, 32), np.uint8), np.zeros((0, 32), np.uint8), []
+    ).shape == (0, 32)
+    assert native.sha512_batch([]).shape == (0, 64)

@@ -136,19 +136,20 @@ def test_sharded_comb_quorum_step():
     prep, _fallback = prepare_comb_batch(items, bank)
     inst = np.arange(16, dtype=np.int32) % n_inst
     onehot = np.eye(n_inst, dtype=np.int32)[inst]
-    data = NamedSharding(mesh, P("dp"))
+    vec = NamedSharding(mesh, P("dp"))  # (B,)
+    mat = NamedSharding(mesh, P(None, "dp"))  # batch axis trailing
     repl = NamedSharding(mesh, P())
     s_nib, k_nib, a_idx, r_y, r_sign, precheck = prep.arrays()
     args = [
-        jax.device_put(s_nib, data),
-        jax.device_put(k_nib, data),
-        jax.device_put(a_idx, data),
+        jax.device_put(s_nib, mat),
+        jax.device_put(k_nib, mat),
+        jax.device_put(a_idx, vec),
         jax.device_put(np.asarray(bank.device_tables()), repl),
         jax.device_put(comb.base_table(), repl),
-        jax.device_put(r_y, data),
-        jax.device_put(r_sign, data),
-        jax.device_put(precheck, data),
-        jax.device_put(onehot, data),
+        jax.device_put(r_y, mat),
+        jax.device_put(r_sign, vec),
+        jax.device_put(precheck, vec),
+        jax.device_put(onehot, NamedSharding(mesh, P("dp", None))),
     ]
     verdict, counts = make_comb_quorum_step(mesh)(*args)
     verdict, counts = np.asarray(verdict), np.asarray(counts)
@@ -174,9 +175,12 @@ def test_sharded_quorum_step():
     prep = prepare_batch(items)
     inst = np.arange(16, dtype=np.int32) % n_inst
     onehot = np.eye(n_inst, dtype=np.int32)[inst]
-    sharding = NamedSharding(mesh, P("dp"))
-    args = [jax.device_put(a, sharding) for a in prep.arrays()]
-    args.append(jax.device_put(onehot, sharding))
+    vec = NamedSharding(mesh, P("dp"))
+    mat = NamedSharding(mesh, P(None, "dp"))  # batch axis trailing
+    # arg order: a_y, a_sign, r_y, r_sign, s_bits, k_bits, precheck
+    specs = [mat, vec, mat, vec, mat, mat, vec]
+    args = [jax.device_put(a, s) for a, s in zip(prep.arrays(), specs)]
+    args.append(jax.device_put(onehot, NamedSharding(mesh, P("dp", None))))
 
     verdict, counts = make_quorum_step(mesh)(*args)
     verdict, counts = np.asarray(verdict), np.asarray(counts)
