@@ -1,0 +1,216 @@
+"""Consensus-throughput benchmark: committed requests/s on a LocalCommittee.
+
+BASELINE.md config ladder, measured end to end through the real stack
+(signed wire messages, batch verification, ordered execution, replies):
+
+  1. n=4  (f=1), CPU verify        — parity with the reference's run.bat
+  2. n=16 (f=5), TPU batched verify (--verifier tpu)
+  3. n=64, many concurrent clients, QC batching
+  5. n=64 view-change storm (--storm): crash the primary mid-load,
+     measure failover + post-failover throughput.
+
+(Config 4, the 256-node BLS aggregate committee, lives with the BLS
+backend — see crypto/bls.py and tests once present.)
+
+The load is throughput-bound: `--outstanding` concurrent in-flight
+requests are kept open per client (closed-loop with high concurrency),
+so the committee pipelines many sequence numbers (the reference was
+hard-serialized at one in-flight instance ≈ 0.3-0.5 req/s; SURVEY.md §6).
+
+Prints ONE JSON line per config:
+  {"config", "n", "committed_req_s", "p50_ms", "p99_ms", ...}
+
+Usage:
+  python bench_consensus.py [--configs 1,2,3] [--verifier cpu|tpu]
+      [--seconds 10] [--clients 8] [--outstanding 64] [--storm]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+from typing import List
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def _emit(rec: dict) -> None:
+    os.write(1, (json.dumps(rec) + "\n").encode())
+
+
+async def _pump(client, stop_at: float, latencies: List[float], errors: List[int]):
+    """One closed-loop driver: keep exactly one request in flight, record
+    per-request latency. Concurrency comes from running many of these."""
+    i = 0
+    while time.perf_counter() < stop_at:
+        t0 = time.perf_counter()
+        try:
+            await client.submit(f"put k{id(client) % 997}_{i % 64} {i}")
+            latencies.append(time.perf_counter() - t0)
+        except (asyncio.TimeoutError, TimeoutError):
+            errors.append(1)
+        i += 1
+
+
+async def run_config(
+    name: str,
+    n: int,
+    seconds: float,
+    n_clients: int,
+    outstanding: int,
+    verifier: str,
+    batch: int,
+    storm: bool = False,
+) -> dict:
+    from simple_pbft_tpu.committee import LocalCommittee
+    from simple_pbft_tpu.crypto.tpu_verifier import BUCKETS, TpuVerifier
+
+    factory = None
+    if verifier == "tpu":
+        import simple_pbft_tpu
+
+        simple_pbft_tpu.enable_jit_cache()
+        factory = lambda: TpuVerifier()  # noqa: E731
+        # warm the shared jit cache for every bucket this load can hit
+        # BEFORE the timed window — first compiles are ~30-40 s each
+        from simple_pbft_tpu.crypto import ed25519_cpu as _ref
+        from simple_pbft_tpu.crypto.verifier import BatchItem as _BI
+
+        seed = b"\xbb" * 32
+        pk = _ref.public_key(seed)
+        top = next(b for b in BUCKETS if b >= min(batch + 8, BUCKETS[-1]))
+        warm = [
+            _BI(pk, b"warm %d" % i, _ref.sign(seed, b"warm %d" % i))
+            for i in range(8)
+        ]
+        warmer = TpuVerifier()
+        t0 = time.perf_counter()
+        for b in BUCKETS:
+            if b > top:
+                break
+            warmer.verify_batch((warm * ((b + 7) // 8))[:b])
+        print(
+            f"warmed buckets <= {top} in {time.perf_counter() - t0:.0f}s",
+            file=sys.stderr,
+        )
+
+    com = LocalCommittee.build(
+        n=n,
+        clients=n_clients,
+        verifier_factory=factory,
+        max_batch=batch,
+        view_timeout=30.0 if not storm else 3.0,
+        checkpoint_interval=64,
+        watermark_window=1024,
+    )
+    for c in com.clients:
+        c.request_timeout = 30.0
+    com.start()
+
+    latencies: List[float] = []
+    errors: List[int] = []
+    t_start = time.perf_counter()
+    stop_at = t_start + seconds
+    per_client = max(1, outstanding // n_clients)
+    pumps = [
+        asyncio.create_task(_pump(c, stop_at, latencies, errors))
+        for c in com.clients
+        for _ in range(per_client)
+    ]
+
+    crash_info = {}
+    if storm:
+        # config 5: kill the primary mid-load REPEATEDLY; committee must
+        # view-change and keep committing under each successor
+        crashes = 0
+        next_crash = t_start + seconds / 6
+        while time.perf_counter() < stop_at - 1.0:
+            await asyncio.sleep(0.2)
+            if time.perf_counter() >= next_crash and crashes < 3:
+                view = max(r.view for r in com.replicas)
+                primary_id = com.cfg.primary(view)
+                com.replica(primary_id).kill()  # crash-stop, no drain
+                crashes += 1
+                next_crash += seconds / 5
+        crash_info = {"primary_crashes": crashes}
+
+    await asyncio.gather(*pumps, return_exceptions=True)
+    elapsed = time.perf_counter() - t_start
+    committed = len(latencies)
+    # replica-side truth: total requests the (surviving) replicas executed
+    exec_counts = sorted(
+        r.metrics.get("committed_requests", 0) for r in com.replicas if r._running
+    )
+    await com.stop()
+
+    lat_ms = sorted(x * 1e3 for x in latencies)
+
+    def pct(p: float) -> float:
+        return lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))] if lat_ms else 0.0
+
+    rec = {
+        "config": name,
+        "n": n,
+        "verifier": verifier,
+        "clients": n_clients,
+        "outstanding": per_client * n_clients,
+        "batch": batch,
+        "seconds": round(elapsed, 1),
+        "committed_req_s": round(committed / elapsed, 1),
+        "p50_ms": round(pct(0.50), 2),
+        "p99_ms": round(pct(0.99), 2),
+        "client_timeouts": len(errors),
+        "replica_exec_min": exec_counts[0] if exec_counts else 0,
+        "replica_exec_max": exec_counts[-1] if exec_counts else 0,
+        "vs_reference_req_s": round(committed / elapsed / 0.4, 1),  # ref ~0.4/s
+    }
+    rec.update(crash_info)
+    return rec
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="1")
+    ap.add_argument("--verifier", default="cpu", choices=["cpu", "tpu"])
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--outstanding", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--storm", action="store_true")
+    args = ap.parse_args()
+
+    ladder = {
+        "1": dict(name="pbft-n4", n=4),
+        "2": dict(name="pbft-n16", n=16),
+        "3": dict(name="pbft-n64", n=64),
+        "100": dict(name="pbft-n100", n=100),
+    }
+    for key in args.configs.split(","):
+        key = key.strip()
+        if args.storm:
+            rec = await run_config(
+                "viewchange-storm-n64", 64, args.seconds, args.clients,
+                args.outstanding, args.verifier, args.batch, storm=True,
+            )
+        else:
+            cfg = ladder[key]
+            rec = await run_config(
+                cfg["name"], cfg["n"], args.seconds, args.clients,
+                args.outstanding, args.verifier, args.batch,
+            )
+        _emit(rec)
+        if args.storm:
+            break
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

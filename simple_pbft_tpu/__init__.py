@@ -25,3 +25,20 @@ redesigned TPU-first:
 """
 
 __version__ = "0.1.0"
+
+
+def enable_jit_cache(path: str | None = None) -> None:
+    """Point JAX's persistent compilation cache at a shared directory so
+    the crypto kernels (40-60 s compiles on small CPU hosts) compile once
+    per machine, not once per process. Call before the first jit
+    execution. Used by tests/conftest.py and the benchmarks; override the
+    location with SIMPLE_PBFT_JIT_CACHE or the `path` argument."""
+    import os
+
+    import jax
+
+    cache = path or os.environ.get(
+        "SIMPLE_PBFT_JIT_CACHE", "/tmp/jax_cache_simple_pbft"
+    )
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)

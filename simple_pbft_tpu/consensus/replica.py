@@ -104,6 +104,9 @@ class Replica:
         self._replica_set = frozenset(cfg.replica_ids)
         self._running = False
         self._task: Optional[asyncio.Task] = None
+        self._ingest_task: Optional[asyncio.Task] = None
+        self._queue: Optional[asyncio.Queue] = None
+        self._stranded: List = []  # jobs orphaned by cancelling ingest
         # backup-side buffer of relayed-but-unexecuted client requests:
         # the failover evidence, and the new primary's starting backlog
         self.relay_buffer: Dict[Tuple[str, int], Request] = {}
@@ -122,17 +125,51 @@ class Replica:
 
     def start(self) -> None:
         self._running = True
-        self._task = asyncio.get_running_loop().create_task(self._run())
+        loop = asyncio.get_running_loop()
+        self._queue = asyncio.Queue(maxsize=1)
+        self._stranded = []
+        self._ingest_task = loop.create_task(self._ingest())
+        self._task = loop.create_task(self._route_loop())
 
     async def stop(self) -> None:
+        """Graceful: stop ingesting new traffic, then let the route loop
+        DRAIN sweeps already decoded or in the verify thread before
+        exiting — a sweep that entered the pipeline is never dropped by a
+        clean shutdown (crash-stop loses only what the network would have
+        lost anyway)."""
         self._running = False
         self.vc.cancel()
-        if self._task:
-            self._task.cancel()
+        if self._ingest_task:
+            self._ingest_task.cancel()
             try:
-                await self._task
+                await self._ingest_task
             except asyncio.CancelledError:
                 pass
+        if self._task:
+            try:
+                # sentinel wakes the route loop if it is idle
+                self._queue.put_nowait(None)
+            except asyncio.QueueFull:
+                pass
+            try:
+                await asyncio.wait_for(self._task, timeout=10.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self._task.cancel()
+                try:
+                    await self._task
+                except asyncio.CancelledError:
+                    pass
+
+    def kill(self) -> None:
+        """Crash-stop: abort immediately, dropping everything in flight.
+        This is the failure model benchmarks and fault-injection tests
+        mean by "crash the primary" — stop() is the orderly drain."""
+        self._running = False
+        self.vc.cancel()
+        for t in (self._ingest_task, self._task):
+            if t is not None:
+                t.cancel()
+        self._stranded.clear()
 
     def has_outstanding_work(self) -> bool:
         """Is there client work this replica is waiting on the committee
@@ -147,7 +184,14 @@ class Replica:
                 self.pending_requests.append(req)
         self.relay_buffer.clear()
 
-    async def _run(self) -> None:
+    async def _ingest(self) -> None:
+        """Stage 1 of the runtime pipeline: drain the transport, decode,
+        and launch the signature batch-verify off-loop in a worker thread.
+        The queue depth of 1 in-flight job means the verifier — a TPU
+        round trip in the `tpu` backend — overlaps with draining and
+        decoding the next sweep, and the event loop itself never blocks
+        on the device (SURVEY.md §7 "pipeline verify of round k+1 with
+        round k's commits"; VERDICT round-1 weak #6)."""
         while self._running:
             raw = await self.transport.recv()
             sweep = [raw]
@@ -157,50 +201,103 @@ class Replica:
                     break
                 sweep.append(nxt)
             try:
-                await self.process_sweep(sweep)
-            except asyncio.CancelledError:
-                raise
+                job = self._start_sweep(sweep)
             except Exception:
-                # a replica must never die from one hostile/buggy sweep
-                log.exception("%s: sweep processing failed", self.id)
+                log.exception("%s: sweep decode failed", self.id)
                 self.metrics["sweep_errors"] += 1
+                continue
+            try:
+                await self._queue.put(job)
+            except asyncio.CancelledError:
+                # stop() cancelled us while the queue was full: this job's
+                # verify is already running — strand it for the route
+                # loop's drain instead of dropping it
+                self._stranded.append(job)
+                raise
+
+    async def _route_loop(self) -> None:
+        """Stage 2: await each sweep's verdict bitmap, route survivors,
+        propose. Exits only when stopped AND the pipeline is drained
+        (queued jobs plus any job stranded by cancelling ingest mid-put)."""
+        while True:
+            if self._running:
+                job = await self._queue.get()  # woken by stop()'s sentinel
+                jobs = [job]
+            else:
+                jobs = []
+            while True:  # opportunistic drain (bounded by queue size)
+                try:
+                    jobs.append(self._queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            if not self._running:
+                jobs.extend(self._stranded)  # ingest cancelled mid-put
+                self._stranded.clear()
+            for j in jobs:
+                if j is None:
+                    continue  # stop() sentinel
+                try:
+                    await self._finish_sweep(*j)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    # a replica must never die from one hostile/buggy sweep
+                    log.exception("%s: sweep processing failed", self.id)
+                    self.metrics["sweep_errors"] += 1
+            if not self._running and self._queue.empty() and not self._stranded:
+                return
 
     # ------------------------------------------------------------------
     # the verify seam: decode sweep -> one batch verify -> route
     # ------------------------------------------------------------------
 
-    async def process_sweep(self, sweep: List[bytes]) -> None:
-        """Decode a sweep of wire messages, batch-verify every signature in
-        it with ONE verifier call, then route the survivors."""
+    def _start_sweep(self, sweep: List[bytes]):
+        """Decode a sweep and launch its signature verification in a
+        worker thread (hashlib and the device round trip both release the
+        GIL / the loop). Returns (decoded, spans, verify_task | None)."""
         decoded: List[Message] = []
         for raw in sweep:
             try:
                 decoded.append(Message.from_wire(raw))
             except ValueError:
                 self.metrics["malformed"] += 1
-        if not decoded:
-            return
-
-        accepted = decoded
-        if self.cfg.verify_signatures:
+        spans: List[Tuple[int, int]] = []
+        verify_task = None
+        if decoded and self.cfg.verify_signatures:
             items: List[BatchItem] = []
-            spans: List[Tuple[int, int]] = []  # msg -> [start, end) in items
             for msg in decoded:
                 start = len(items)
                 items.extend(self._batch_items(msg))
                 spans.append((start, len(items)))
-            bitmap = self.verifier.verify_batch(items) if items else []
+            if items:
+                verify_task = asyncio.get_running_loop().create_task(
+                    asyncio.to_thread(self.verifier.verify_batch, items)
+                )
             self.metrics["verified_sigs"] += len(items)
+        return decoded, spans, verify_task
+
+    async def _finish_sweep(self, decoded, spans, verify_task) -> None:
+        if not decoded:
+            return
+        accepted = decoded
+        if self.cfg.verify_signatures:
+            bitmap = await verify_task if verify_task is not None else []
             accepted = []
             for msg, (s, e) in zip(decoded, spans):
                 if e > s and all(bitmap[s:e]):
                     accepted.append(msg)
                 else:
                     self.metrics["bad_sig"] += 1
-
         for msg in accepted:
             await self._route(msg)
         await self._propose_if_ready()
+
+    async def process_sweep(self, sweep: List[bytes]) -> None:
+        """Decode a sweep of wire messages, batch-verify every signature in
+        it with ONE verifier call, then route the survivors. (Direct-drive
+        entry for tests; the runtime pipelines the same two halves.)"""
+        decoded, spans, verify_task = self._start_sweep(sweep)
+        await self._finish_sweep(decoded, spans, verify_task)
 
     def _batch_items(self, msg: Message) -> List[BatchItem]:
         """Signature obligations for one message. An empty return means the

@@ -31,6 +31,7 @@ double-scalar multiplication and an equality — no second ladder.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -282,42 +283,57 @@ class KeyBank:
         self._np = np.zeros((self._cap, self._rows_per_key, comb.ROW), np.int32)
         self._dev = None
         self._dirty = True
+        # the replica pipeline verifies sweep k+1 in a second worker thread
+        # while sweep k is in flight — bank mutation must be atomic or two
+        # first-sighted pubkeys can race `len(self._index)` and share a
+        # table row (one key permanently verifying against the wrong point)
+        self._lock = threading.Lock()
 
     def lookup(self, pubkey: bytes) -> int:
         """-> table row for pubkey, -1 if the key is invalid (bad length /
         not a curve point), or UNCACHED if the bank is full. Builds and
-        caches the table on miss."""
-        idx = self._index.get(pubkey)
-        if idx is not None:
-            return idx
-        if len(pubkey) != 32 or pubkey in self._invalid_cache:
-            return -1
+        caches the table on miss. Thread-safe."""
+        with self._lock:
+            idx = self._index.get(pubkey)
+            if idx is not None:
+                return idx
+            if len(pubkey) != 32 or pubkey in self._invalid_cache:
+                return -1
+        # exact-bigint table construction is the slow part (~0.5 s/key for
+        # fused mode): run it outside the lock, re-checking on re-entry
         pt = ref.point_decompress(pubkey)
         if pt is None:
-            if len(self._invalid_cache) < 4096:  # bounded negative cache
-                self._invalid_cache.add(pubkey)
+            with self._lock:
+                if len(self._invalid_cache) < 4096:  # bounded negative cache
+                    self._invalid_cache.add(pubkey)
             return -1
-        idx = len(self._index)
-        if idx >= self._max_keys:
-            return self.UNCACHED
-        if idx >= self._cap:
-            self._cap = min(self._cap * 2, self._max_keys)
-            grown = np.zeros((self._cap,) + self._np.shape[1:], np.int32)
-            grown[:idx] = self._np[:idx]
-            self._np = grown
-        self._np[idx] = self._builder(pt)
-        self._index[pubkey] = idx
-        self._dirty = True
-        return idx
+        table = self._builder(pt)
+        with self._lock:
+            idx = self._index.get(pubkey)
+            if idx is not None:  # raced: another thread built it first
+                return idx
+            idx = len(self._index)
+            if idx >= self._max_keys:
+                return self.UNCACHED
+            if idx >= self._cap:
+                self._cap = min(self._cap * 2, self._max_keys)
+                grown = np.zeros((self._cap,) + self._np.shape[1:], np.int32)
+                grown[:idx] = self._np[:idx]
+                self._np = grown
+            self._np[idx] = table
+            self._index[pubkey] = idx
+            self._dirty = True
+            return idx
 
     def device_tables(self) -> jnp.ndarray:
         """Flat (cap * rows_per_key, ROW) packed-row table on device."""
-        if self._dirty or self._dev is None:
-            self._dev = jnp.asarray(
-                self._np.reshape(self._cap * self._rows_per_key, comb.ROW)
-            )
-            self._dirty = False
-        return self._dev
+        with self._lock:
+            if self._dirty or self._dev is None:
+                self._dev = jnp.asarray(
+                    self._np.reshape(self._cap * self._rows_per_key, comb.ROW)
+                )
+                self._dirty = False
+            return self._dev
 
 
 def prepare_comb_batch(
@@ -362,6 +378,38 @@ def prepare_comb_batch(
         ok,
     )
     return batch, fallback
+
+
+_JIT_CACHE: Dict[str, object] = {}
+
+# One device pass at a time, process-wide. The replica runtime calls
+# verify_batch from worker threads (asyncio.to_thread) so the event loop
+# never blocks on the device; without this lock N replicas' first calls
+# would TRACE AND COMPILE the same jit signature concurrently — N
+# GIL-interleaved compiles of identical kernels (minutes on a small CPU
+# host) instead of one compile plus N-1 cache hits. Steady-state cost is
+# nil: a single chip serializes execution anyway.
+_DEVICE_LOCK = threading.Lock()
+
+
+def _shared_jit(mode: str):
+    """One jitted callable per mode, shared by every unmeshed TpuVerifier.
+
+    A per-instance `jax.jit` wrapper would give each verifier its own
+    compile cache — an N-replica committee would then compile the same
+    kernel N times per bucket size (minutes of wasted wall clock, and a
+    practical deadlock on single-core CI hosts)."""
+    fn = _JIT_CACHE.get(mode)
+    if fn is None:
+        fn = jax.jit(
+            {
+                "comb": comb.comb_verify_kernel,
+                "fused": comb.fused_verify_kernel,
+                "ladder": verify_kernel,
+            }[mode]
+        )
+        _JIT_CACHE[mode] = fn
+    return fn
 
 
 class TpuVerifier:
@@ -425,13 +473,7 @@ class TpuVerifier:
                     f"{self._align} devices"
                 )
         else:
-            self._fn = jax.jit(
-                {
-                    "comb": comb.comb_verify_kernel,
-                    "fused": comb.fused_verify_kernel,
-                    "ladder": verify_kernel,
-                }[mode]
-            )
+            self._fn = _shared_jit(mode)
             self._align = 1
 
     def verify_batch(self, items: Sequence[BatchItem]) -> List[bool]:
@@ -457,12 +499,14 @@ class TpuVerifier:
             else:
                 args = (s_nib, k_nib, a_idx, tables, r_y, r_sign, precheck)
             # np.array (copy): fallback rows below are written in place
-            verdict = np.array(self._fn(*args))
+            with _DEVICE_LOCK:
+                verdict = np.array(self._fn(*args))
             if fallback:  # keys over the bank cap: CPU path
                 for i in fallback:
                     it = items[i]
                     verdict[i] = ref.verify(it.pubkey, it.msg, it.sig)
         else:
             prep = prepare_batch(items).padded(size)
-            verdict = np.asarray(self._fn(*prep.arrays()))
+            with _DEVICE_LOCK:
+                verdict = np.asarray(self._fn(*prep.arrays()))
         return verdict[: prep.n].tolist()

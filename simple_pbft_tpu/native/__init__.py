@@ -37,18 +37,23 @@ _i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
 
 
 def _build() -> bool:
-    cmd = [
-        "g++", "-O3", "-fopenmp", "-shared", "-fPIC",
-        "-o", _SO + ".tmp", _SRC,
-    ]
+    # per-process temp name: concurrent builders (multi-process launch,
+    # parallel test workers) must never interleave linker output in a
+    # shared file; os.replace keeps the final install atomic
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-fopenmp", "-shared", "-fPIC", "-o", tmp, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(_SO + ".tmp", _SO)
+        os.replace(tmp, _SO)
         return True
     except (OSError, subprocess.SubprocessError) as e:
         detail = getattr(e, "stderr", b"") or b""
         log.warning("native build failed (%s) %s — using Python fallback",
                     e, detail.decode(errors="replace")[:500])
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

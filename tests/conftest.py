@@ -26,3 +26,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the crypto kernels take ~40-60 s each to
+# compile on a small CPU host; caching them across test runs turns every
+# rerun's compile into a disk load. Safe to share — entries key on the
+# full HLO + flags.
+import simple_pbft_tpu  # noqa: E402
+
+simple_pbft_tpu.enable_jit_cache()

@@ -58,7 +58,7 @@ def test_failover_after_primary_crash():
         assert await client.submit("put a 1") == "ok"
 
         # kill the view-0 primary
-        await c.replica("r0").stop()
+        c.replica("r0").kill()
         result = await client.submit("put b 2", retries=20)
         assert result == "ok"
         survivors = [r for r in c.replicas if r.id != "r0"]
@@ -87,7 +87,7 @@ def test_failover_after_stable_checkpoint():
         for i in range(4):  # past two checkpoint intervals
             assert await client.submit(f"put k{i} {i}") == "ok"
         assert all(r.stable_seq > 0 for r in c.replicas)
-        await c.replica("r0").stop()
+        c.replica("r0").kill()
         assert await client.submit("put after 1", retries=20) == "ok"
         survivors = [r for r in c.replicas if r.id != "r0"]
         assert all(r.view >= 1 for r in survivors)
@@ -106,8 +106,8 @@ def test_cascaded_failover_two_primaries_down():
         c.start()
         client = c.clients[0]
         client.request_timeout = 0.25
-        await c.replica("r0").stop()
-        await c.replica("r1").stop()
+        c.replica("r0").kill()
+        c.replica("r1").kill()
         assert await client.submit("put x 9", retries=40) == "ok"
         survivors = [r for r in c.replicas if r.id not in ("r0", "r1")]
         assert all(r.view >= 2 for r in survivors)
