@@ -87,7 +87,11 @@ async def run_config(
 
         seed = b"\xbb" * 32
         pk = _ref.public_key(seed)
-        top = next(b for b in BUCKETS if b >= min(batch + 8, BUCKETS[-1]))
+        # a backup's drain sweep can batch a whole proposal (batch client
+        # sigs + 1) PLUS a round of votes from every peer — warm through
+        # that bucket or a 30-40 s compile lands inside the timed window
+        need = batch + 1 + 4 * n + 64
+        top = next((b for b in BUCKETS if b >= need), BUCKETS[-1])
         warm = [
             _BI(pk, b"warm %d" % i, _ref.sign(seed, b"warm %d" % i))
             for i in range(8)
@@ -136,9 +140,11 @@ async def run_config(
         while time.perf_counter() < stop_at - 1.0:
             await asyncio.sleep(0.2)
             if time.perf_counter() >= next_crash and crashes < 3:
-                view = max(r.view for r in com.replicas)
-                primary_id = com.cfg.primary(view)
-                com.replica(primary_id).kill()  # crash-stop, no drain
+                view = max(r.view for r in com.replicas if r._running)
+                target = com.replica(com.cfg.primary(view))
+                if not target._running:
+                    continue  # failover still in progress; don't double-count
+                target.kill()  # crash-stop, no drain
                 crashes += 1
                 next_crash += seconds / 5
         crash_info = {"primary_crashes": crashes}
@@ -202,6 +208,12 @@ async def main() -> None:
                 args.outstanding, args.verifier, args.batch, storm=True,
             )
         else:
+            if key not in ladder:
+                sys.exit(
+                    f"unknown config {key!r}: valid are "
+                    f"{sorted(ladder)} (config 5, the view-change storm, "
+                    f"runs via --storm)"
+                )
             cfg = ladder[key]
             rec = await run_config(
                 cfg["name"], cfg["n"], args.seconds, args.clients,

@@ -37,8 +37,9 @@ def enable_jit_cache(path: str | None = None) -> None:
 
     import jax
 
+    uid = os.getuid() if hasattr(os, "getuid") else 0
     cache = path or os.environ.get(
-        "SIMPLE_PBFT_JIT_CACHE", "/tmp/jax_cache_simple_pbft"
+        "SIMPLE_PBFT_JIT_CACHE", f"/tmp/jax_cache_simple_pbft_{uid}"
     )
     jax.config.update("jax_compilation_cache_dir", cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)

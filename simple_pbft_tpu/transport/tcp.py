@@ -65,6 +65,7 @@ class TcpTransport:
         self._outboxes: Dict[str, asyncio.Queue] = {}
         self._sender_tasks: Dict[str, asyncio.Task] = {}
         self._server: Optional[asyncio.AbstractServer] = None
+        self._conn_writers: set = set()  # live inbound connections
         self.metrics: Dict[str, int] = {
             "sent": 0,
             "recv": 0,
@@ -82,6 +83,10 @@ class TcpTransport:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+            # close live inbound connections FIRST: handlers sit blocked in
+            # readexactly and (Python >= 3.12) wait_closed() waits for them
+            for w in list(self._conn_writers):
+                w.close()
             await self._server.wait_closed()
         for task in self._sender_tasks.values():
             task.cancel()
@@ -103,6 +108,7 @@ class TcpTransport:
     async def _on_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        self._conn_writers.add(writer)
         try:
             while True:
                 header = await reader.readexactly(4)
@@ -125,6 +131,7 @@ class TcpTransport:
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
+            self._conn_writers.discard(writer)
             writer.close()
             try:
                 await writer.wait_closed()
@@ -181,6 +188,7 @@ class TcpTransport:
         if dest == self.node_id:
             try:
                 self._recv_q.put_nowait(raw)
+                self._recv_bytes += len(raw)  # recv() decrements for every frame
             except asyncio.QueueFull:
                 self.metrics["dropped_recv"] += 1
             return

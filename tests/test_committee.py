@@ -353,7 +353,10 @@ def test_committee_over_tpu_verifier():
             Signer("r1", com.keys["r2"].seed).sign_msg(forged)
             forged.sender = "r1"
             await com.net.endpoint("r2").send("r0", forged.to_wire())
-            await asyncio.sleep(0.3)
+            for _ in range(100):  # poll: the verify may still be in flight
+                if r0.metrics["bad_sig"] >= 1:
+                    break
+                await asyncio.sleep(0.1)
             assert r0.metrics["bad_sig"] >= 1
             assert await com.clients[0].submit("get t3") == "3"
             await asyncio.sleep(0.5)  # let laggards finish the last block
