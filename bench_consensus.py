@@ -6,11 +6,10 @@ BASELINE.md config ladder, measured end to end through the real stack
   1. n=4  (f=1), CPU verify        — parity with the reference's run.bat
   2. n=16 (f=5), TPU batched verify (--verifier tpu)
   3. n=64, many concurrent clients, QC batching
+  4. n=256, BLS aggregate quorum certificates (qc_mode: one pairing
+     check per QC instead of 2f+1 signature checks; crypto/bls.py)
   5. n=64 view-change storm (--storm): crash the primary mid-load,
      measure failover + post-failover throughput.
-
-(Config 4, the 256-node BLS aggregate committee, lives with the BLS
-backend — see crypto/bls.py and tests once present.)
 
 The load is throughput-bound: `--outstanding` concurrent in-flight
 requests are kept open per client (closed-loop with high concurrency),
@@ -70,6 +69,7 @@ async def run_config(
     verifier: str,
     batch: int,
     storm: bool = False,
+    qc_mode: bool = False,
 ) -> dict:
     from simple_pbft_tpu.committee import LocalCommittee
     from simple_pbft_tpu.crypto.tpu_verifier import BUCKETS, TpuVerifier
@@ -115,6 +115,7 @@ async def run_config(
         view_timeout=30.0 if not storm else 3.0,
         checkpoint_interval=64,
         watermark_window=1024,
+        qc_mode=qc_mode,
     )
     for c in com.clients:
         c.request_timeout = 30.0
@@ -198,6 +199,7 @@ async def main() -> None:
         "1": dict(name="pbft-n4", n=4),
         "2": dict(name="pbft-n16", n=16),
         "3": dict(name="pbft-n64", n=64),
+        "4": dict(name="bls-qc-n256", n=256, qc_mode=True),
         "100": dict(name="pbft-n100", n=100),
     }
     for key in args.configs.split(","):
@@ -218,6 +220,7 @@ async def main() -> None:
             rec = await run_config(
                 cfg["name"], cfg["n"], args.seconds, args.clients,
                 args.outstanding, args.verifier, args.batch,
+                qc_mode=cfg.get("qc_mode", False),
             )
         _emit(rec)
         if args.storm:

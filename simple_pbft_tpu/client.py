@@ -16,7 +16,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 from collections import defaultdict
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 from .config import CommitteeConfig
 from .crypto.signer import Signer
@@ -43,7 +43,7 @@ class Client:
         self.request_timeout = request_timeout
         self._ts = itertools.count(1)
         self._waiters: Dict[int, asyncio.Future] = {}
-        self._replies: Dict[int, Dict[str, Tuple[str, int]]] = defaultdict(dict)
+        self._replies: Dict[int, Dict[str, str]] = defaultdict(dict)
         self._task: Optional[asyncio.Task] = None
         self.view_hint = 0  # latest view seen in replies
 
@@ -90,11 +90,17 @@ class Client:
         if fut is None or fut.done():
             return
         self.view_hint = max(self.view_hint, msg.view)
-        self._replies[ts][msg.sender] = (msg.result, msg.view)
-        counts: Dict[Tuple[str, int], int] = defaultdict(int)
+        # f+1 matching is on the RESULT only (Castro-Liskov §2.4): honest
+        # replicas may execute the same request in different views when a
+        # failover re-proposes it, and their replies still agree on the
+        # outcome — matching on (result, view) would deadlock exactly
+        # when a view change lands mid-request. The view rides along
+        # purely as the primary hint above.
+        self._replies[ts][msg.sender] = msg.result
+        counts: Dict[str, int] = defaultdict(int)
         for val in self._replies[ts].values():
             counts[val] += 1
-        for (result, _view), cnt in counts.items():
+        for result, cnt in counts.items():
             if cnt >= self.cfg.weak_quorum:
                 fut.set_result(result)
                 return

@@ -38,6 +38,7 @@ from ..app import Application, KVStore
 from ..config import CommitteeConfig
 from ..crypto.signer import Signer
 from ..crypto.verifier import BatchItem, Verifier, best_cpu_verifier
+from ..logutil import ReplicaStats
 from ..messages import (
     Checkpoint,
     Commit,
@@ -45,6 +46,7 @@ from ..messages import (
     NewView,
     PrePrepare,
     Prepare,
+    QuorumCert,
     Reply,
     Request,
     StateRequest,
@@ -52,6 +54,7 @@ from ..messages import (
     ViewChange,
 )
 from ..transport.base import Transport
+from . import qc as qc_mod
 from .state import ExecuteBlock, Instance, SendCommit, SendPrepare
 from .viewchange import (
     ViewChanger,
@@ -101,6 +104,7 @@ class Replica:
         self.snapshots: Dict[int, str] = {}  # our app snapshots, by seq
         self.pending_sync: Optional[Tuple[int, str]] = None  # (seq, digest)
         self.metrics: Dict[str, int] = defaultdict(int)
+        self.stats = ReplicaStats()  # histograms: sweep/verify/commit
         self._replica_set = frozenset(cfg.replica_ids)
         self._running = False
         self._task: Optional[asyncio.Task] = None
@@ -114,6 +118,14 @@ class Replica:
         # replayed after state transfer advances stable_seq
         self.vc_replay: Dict[int, PrePrepare] = {}
         self.vc = ViewChanger(self)
+        # QC mode: BLS share-signing key + per-(view, seq, phase) record of
+        # certificates this replica (as primary) already aggregated
+        self.bls_sk: Optional[int] = None
+        if cfg.qc_mode:
+            from ..crypto import bls
+
+            self.bls_sk = bls.keygen(seed)[0]
+        self._qc_sent: set = set()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -173,8 +185,21 @@ class Replica:
 
     def has_outstanding_work(self) -> bool:
         """Is there client work this replica is waiting on the committee
-        for? (The condition under which a stalled view must be abandoned.)"""
-        return bool(self.relay_buffer) or bool(self.pending_requests)
+        for? (The condition under which a stalled view must be abandoned.)
+
+        Counts queued/relayed requests AND in-flight proposals: a primary
+        moves requests out of pending_requests when it proposes, so a
+        stalled commit (e.g. a frozen peer starving the quorum) must
+        still register as outstanding or the failover timer fires into a
+        no-op and the view wedges."""
+        if self.relay_buffer or self.pending_requests:
+            return True
+        return any(
+            inst.pre_prepare is not None
+            and not inst.executed
+            and inst.seq > self.executed_seq
+            for inst in self.instances.values()
+        )
 
     def adopt_relayed_requests(self) -> None:
         """On becoming primary: everything relayed and still unexecuted
@@ -261,6 +286,7 @@ class Replica:
                 decoded.append(Message.from_wire(raw))
             except ValueError:
                 self.metrics["malformed"] += 1
+        self.stats.sweep_size.record(len(sweep))
         spans: List[Tuple[int, int]] = []
         verify_task = None
         if decoded and self.cfg.verify_signatures:
@@ -271,14 +297,26 @@ class Replica:
                 spans.append((start, len(items)))
             if items:
                 verify_task = asyncio.get_running_loop().create_task(
-                    asyncio.to_thread(self.verifier.verify_batch, items)
+                    asyncio.to_thread(self._timed_verify, items)
                 )
             self.metrics["verified_sigs"] += len(items)
         return decoded, spans, verify_task
 
+    def _timed_verify(self, items: List[BatchItem]) -> List[bool]:
+        """Worker-thread wrapper: one verifier call, instrumented so
+        verifies/s and per-batch latency are observable (VERDICT weak #8)."""
+        t0 = time.perf_counter()
+        out = self.verifier.verify_batch(items)
+        dt = time.perf_counter() - t0
+        self.stats.verify_ms.record(dt * 1e3)
+        self.stats.verify_items += len(items)
+        self.stats.verify_seconds += dt
+        return out
+
     async def _finish_sweep(self, decoded, spans, verify_task) -> None:
         if not decoded:
             return
+        t0 = time.perf_counter()
         accepted = decoded
         if self.cfg.verify_signatures:
             bitmap = await verify_task if verify_task is not None else []
@@ -291,6 +329,7 @@ class Replica:
         for msg in accepted:
             await self._route(msg)
         await self._propose_if_ready()
+        self.stats.sweep_ms.record((time.perf_counter() - t0) * 1e3)
 
     async def process_sweep(self, sweep: List[bytes]) -> None:
         """Decode a sweep of wire messages, batch-verify every signature in
@@ -308,7 +347,7 @@ class Replica:
         if isinstance(
             msg,
             (PrePrepare, Prepare, Commit, Checkpoint, ViewChange, NewView,
-             StateRequest, StateResponse),
+             QuorumCert, StateRequest, StateResponse),
         ):
             if msg.sender not in self._replica_set:
                 return []
@@ -384,6 +423,8 @@ class Replica:
             await self._on_request(msg)
         elif isinstance(msg, (PrePrepare, Prepare, Commit)):
             await self._on_phase(msg)
+        elif isinstance(msg, QuorumCert):
+            await self._on_qc(msg)
         elif isinstance(msg, Checkpoint):
             await self._on_checkpoint(msg)
         elif isinstance(msg, StateRequest):
@@ -407,6 +448,7 @@ class Replica:
                 seq=seq,
                 quorum=self.cfg.quorum,
                 primary=self.cfg.primary(view),
+                qc_mode=self.cfg.qc_mode,
             )
             self.instances[key] = inst
         return inst
@@ -498,24 +540,113 @@ class Replica:
                 self.metrics["bad_block"] += 1
                 return
             actions = inst.on_pre_prepare(msg)
+            if inst.pre_prepare is not None and inst.t_started == 0.0:
+                inst.t_started = time.perf_counter()  # commit-latency clock
         elif isinstance(msg, Prepare):
             actions = inst.on_prepare(msg)
         else:
             actions = inst.on_commit(msg)
         for act in actions:
             await self._perform(act)
+        if (
+            self.cfg.qc_mode
+            and self.is_primary
+            and isinstance(msg, (Prepare, Commit))
+        ):
+            await self._try_aggregate(
+                inst, "prepare" if isinstance(msg, Prepare) else "commit"
+            )
+
+    # ------------------------------------------------------------------
+    # QC mode: primary-side aggregation + certificate handling
+    # ------------------------------------------------------------------
+
+    async def _try_aggregate(self, inst: Instance, phase: str) -> None:
+        """Primary only: once 2f+1 matching shares are logged for a phase,
+        aggregate them into a QuorumCert, self-check its pairing (one
+        Byzantine share corrupts the aggregate — bisect and exclude on
+        failure), then broadcast. Pairings run off-loop."""
+        key = (inst.view, inst.seq, phase)
+        if key in self._qc_sent or inst.digest is None:
+            return
+        log_map = inst.prepares if phase == "prepare" else inst.commits
+        shares = {
+            sender: v.bls_share
+            for sender, v in log_map.items()
+            if v.digest == inst.digest
+            and v.bls_share
+            and qc_mod.share_valid_shape(v.bls_share)
+        }
+        if len(shares) < self.cfg.quorum:
+            return
+        cert = qc_mod.build_qc(
+            phase, inst.view, inst.seq, inst.digest, shares, self.cfg.quorum
+        )
+        if cert is None:
+            return
+        if not await asyncio.to_thread(qc_mod.verify_qc, self.cfg, cert):
+            self.metrics["qc_aggregate_failed"] += 1
+            good = await asyncio.to_thread(
+                qc_mod.bisect_bad_shares,
+                self.cfg, phase, inst.view, inst.seq, inst.digest, shares,
+            )
+            for sender in set(shares) - set(good):
+                log_map.pop(sender, None)
+                self.metrics["qc_bad_shares"] += 1
+            if len(good) < self.cfg.quorum:
+                return
+            cert = qc_mod.build_qc(
+                phase, inst.view, inst.seq, inst.digest, good, self.cfg.quorum
+            )
+            if cert is None or not await asyncio.to_thread(
+                qc_mod.verify_qc, self.cfg, cert
+            ):
+                return
+        self._qc_sent.add(key)
+        self.signer.sign_msg(cert)
+        self.metrics["qcs_formed"] += 1
+        await self.transport.broadcast(cert.to_wire(), self.cfg.replica_ids)
+        await self._on_qc(cert)  # act on our own certificate
+
+    async def _on_qc(self, msg: QuorumCert) -> None:
+        """A quorum certificate arrives (from the primary, or relayed —
+        it is self-certifying). One pairing check (memoized) then drive
+        the instance's QC transitions."""
+        if not self.cfg.qc_mode:
+            self.metrics["unroutable"] += 1
+            return
+        if self.vc.in_view_change and msg.phase != "commit":
+            # prepare-phase participation stays frozen during a view
+            # change (our VIEW-CHANGE certificate fixed the prepared set),
+            # but a COMMIT QC is committee-level proof of commitment:
+            # executing it is safe in any view, emits no votes (see
+            # _send_vote), and un-wedges a replica whose outstanding work
+            # the rest of the committee already finished
+            self.metrics["dropped_in_viewchange"] += 1
+            return
+        if msg.view != self.view:
+            self.metrics["wrong_view"] += 1
+            return
+        if not self._in_window(msg.seq):
+            self.metrics["out_of_window"] += 1
+            return
+        if not await asyncio.to_thread(qc_mod.verify_qc, self.cfg, msg):
+            self.metrics["bad_qc"] += 1
+            return
+        inst = self._instance(msg.view, msg.seq)
+        actions = (
+            inst.on_prepare_qc(msg)
+            if msg.phase == "prepare"
+            else inst.on_commit_qc(msg)
+        )
+        for act in actions:
+            await self._perform(act)
 
     async def _perform(self, act) -> None:
         if isinstance(act, SendPrepare):
-            vote = Prepare(view=act.view, seq=act.seq, digest=act.digest)
-            self.signer.sign_msg(vote)
-            await self.transport.broadcast(vote.to_wire(), self.cfg.replica_ids)
-            await self._on_phase(vote)  # count own vote
+            await self._send_vote(Prepare, "prepare", act)
         elif isinstance(act, SendCommit):
-            vote = Commit(view=act.view, seq=act.seq, digest=act.digest)
-            self.signer.sign_msg(vote)
-            await self.transport.broadcast(vote.to_wire(), self.cfg.replica_ids)
-            await self._on_phase(vote)
+            await self._send_vote(Commit, "commit", act)
         elif isinstance(act, ExecuteBlock):
             if act.seq <= self.executed_seq:
                 # a re-issued pre-prepare for an already-executed seq
@@ -525,6 +656,32 @@ class Replica:
                 return
             self.ready[act.seq] = act
             await self._execute_ready()
+
+    async def _send_vote(self, cls, phase: str, act) -> None:
+        """Emit one phase vote. Normal mode: ed25519-signed broadcast to
+        every replica (O(n^2) votes committee-wide). QC mode: attach a BLS
+        share and send to the view's primary ONLY (O(n)); the primary
+        aggregates 2f+1 shares into a QuorumCert."""
+        if self.vc.in_view_change:
+            # frozen: no votes leave this replica between VIEW-CHANGE and
+            # NEW-VIEW (QC-mode commit execution may still reach here)
+            self.metrics["vote_suppressed_in_vc"] += 1
+            return
+        vote = cls(view=act.view, seq=act.seq, digest=act.digest)
+        if self.cfg.qc_mode:
+            vote.bls_share = qc_mod.sign_share(
+                self.bls_sk, phase, act.view, act.seq, act.digest
+            )
+            self.signer.sign_msg(vote)
+            primary = self.cfg.primary(act.view)
+            if primary == self.id:
+                await self._on_phase(vote)  # our own share, directly
+            else:
+                await self.transport.send(primary, vote.to_wire())
+            return
+        self.signer.sign_msg(vote)
+        await self.transport.broadcast(vote.to_wire(), self.cfg.replica_ids)
+        await self._on_phase(vote)  # count own vote
 
     # ------------------------------------------------------------------
     # ordered execution
@@ -536,6 +693,11 @@ class Replica:
             self.executed_seq += 1
             self.committed_log.append((act.seq, act.digest))
             self.metrics["committed_blocks"] += 1
+            src = self.instances.get((act.view, act.seq))
+            if src is not None and src.t_started:
+                self.stats.commit_ms.record(
+                    (time.perf_counter() - src.t_started) * 1e3
+                )
             reqs = self._validate_block(act.block)
             if reqs is None:  # unreachable: admission validated on entry
                 self.metrics["exec_bad_block"] += 1
@@ -724,6 +886,7 @@ class Replica:
         self.vc_replay = {
             s: pp for s, pp in self.vc_replay.items() if s > seq
         }
+        self._qc_sent = {k for k in self._qc_sent if k[1] > seq}
         self.seen_requests = {
             (c, ts): assigned
             for (c, ts), assigned in self.seen_requests.items()

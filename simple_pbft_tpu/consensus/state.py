@@ -79,6 +79,13 @@ class Instance:
     prepares: Dict[str, Prepare] = field(default_factory=dict)
     commits: Dict[str, Commit] = field(default_factory=dict)
     executed: bool = False
+    # QC mode (config.qc_mode): transitions are driven by verified
+    # QuorumCerts, not by counting votes locally — votes flow to the
+    # primary only, so a backup's vote logs never reach quorum.
+    qc_mode: bool = False
+    prepare_qc: Optional[Any] = None  # verified QuorumCert(phase=prepare)
+    commit_qc: Optional[Any] = None
+    t_started: float = 0.0  # perf_counter at pre-prepare admission (stats)
 
     # -- phase inputs -------------------------------------------------------
 
@@ -95,6 +102,12 @@ class Instance:
             # primary-identity check; a Byzantine backup must not steal slots)
         if self.pre_prepare is not None:
             return []  # already have one for this slot (first wins)
+        if self.digest is not None and msg.digest != self.digest:
+            # the slot's digest was already fixed by a verified quorum
+            # certificate (QC mode, QC-before-pre-prepare arrival order);
+            # an equivocating primary must not swap in a different block
+            # and ride the stored commit QC into executing it
+            return []
         if PrePrepare.block_digest(msg.block) != msg.digest:
             return []  # digest mismatch — mirrors verifyMsg digest check
         self.pre_prepare = msg
@@ -151,6 +164,10 @@ class Instance:
     # -- transitions --------------------------------------------------------
 
     def _maybe_advance(self) -> List[Action]:
+        if self.qc_mode:
+            # quorum formation happens at the primary via QC aggregation;
+            # local vote counts must not drive transitions
+            return self._maybe_advance_qc()
         out: List[Action] = []
         if self.stage == Stage.PRE_PREPARED and self.prepared():
             self.stage = Stage.PREPARED
@@ -164,11 +181,80 @@ class Instance:
                 )
         return out
 
+    # -- QC-mode transitions -------------------------------------------------
+
+    def on_prepare_qc(self, qc) -> List[Action]:
+        """A VERIFIED prepare QC for this slot. The commit share is only
+        emitted once our own pre-prepare is also held (_maybe_advance_qc):
+        a replica in the commit quorum must be able to produce a P-set
+        entry ({pre_prepare, prepare_qc}) in a view change, or the
+        quorum-intersection argument that protects committed blocks
+        across views breaks."""
+        if (qc.view, qc.seq) != (self.view, self.seq):
+            return []
+        if self.digest is not None and qc.digest != self.digest:
+            return []  # conflicts with the pre-prepare we admitted
+        if self.prepare_qc is not None:
+            return []
+        self.prepare_qc = qc
+        if self.digest is None:
+            self.digest = qc.digest
+        return self._maybe_advance_qc()
+
+    def on_commit_qc(self, qc) -> List[Action]:
+        if (qc.view, qc.seq) != (self.view, self.seq):
+            return []
+        if self.digest is not None and qc.digest != self.digest:
+            return []
+        if self.commit_qc is not None:
+            return []
+        self.commit_qc = qc
+        if self.digest is None:
+            self.digest = qc.digest
+        return self._maybe_advance_qc()
+
+    def _maybe_advance_qc(self) -> List[Action]:
+        out: List[Action] = []
+        if (
+            self.prepare_qc is not None
+            and self.pre_prepare is not None  # must be able to prove the
+            # slot in a view change (prepared_proof needs the block)
+            and self.stage in (Stage.IDLE, Stage.PRE_PREPARED)
+        ):
+            self.stage = Stage.PREPARED
+            out.append(SendCommit(self.view, self.seq, self.digest))
+        if (
+            self.commit_qc is not None
+            and self.stage is not Stage.COMMITTED
+            # a commit QC subsumes the prepare QC (2f+1 replicas held one);
+            # execution still needs the block content from the pre-prepare
+            and self.block is not None
+            and not self.executed
+        ):
+            self.stage = Stage.COMMITTED
+            self.executed = True
+            out.append(
+                ExecuteBlock(self.view, self.seq, self.digest, self.block)
+            )
+        return out
+
     # -- view-change support -------------------------------------------------
 
     def prepared_proof(self) -> Optional[Dict[str, Any]]:
-        """If prepared, the certificate {pre-prepare, 2f+1 prepares} that a
-        VIEW-CHANGE message carries for this slot (Castro-Liskov P-set)."""
+        """If prepared, the certificate a VIEW-CHANGE message carries for
+        this slot (Castro-Liskov P-set): {pre-prepare, 2f+1 prepares} —
+        or, in QC mode, {pre-prepare, prepare_qc}: the aggregate IS the
+        2f+1-signer certificate, one pairing check instead of 2f+1
+        signature checks and a fraction of the wire bytes."""
+        if self.qc_mode:
+            if self.prepare_qc is None or self.pre_prepare is None:
+                return None
+            if self.prepare_qc.digest != self.pre_prepare.digest:
+                return None
+            return {
+                "pre_prepare": self.pre_prepare.to_dict(),
+                "prepare_qc": self.prepare_qc.to_dict(),
+            }
         if not self.prepared():
             return None
         votes = [

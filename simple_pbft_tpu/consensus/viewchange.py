@@ -43,9 +43,11 @@ from ..messages import (
     NewView,
     PrePrepare,
     Prepare,
+    QuorumCert,
     Request,
     ViewChange,
 )
+from . import qc as qc_mod
 
 log = logging.getLogger("pbft.viewchange")
 
@@ -84,8 +86,11 @@ def _sig_item(cfg, msg: Message) -> Optional[BatchItem]:
 
 def validate_prepared_proof(
     cfg, proof: Any, min_seq: int, max_seq: int
-) -> Optional[Tuple[PrePrepare, List[Prepare], List[BatchItem]]]:
-    """One P-set entry: {pre_prepare, prepares[2f+1]} for one seq."""
+) -> Optional[Tuple[PrePrepare, List[Prepare], List[BatchItem], List[QuorumCert]]]:
+    """One P-set entry for one seq: {pre_prepare, prepares[2f+1]} — or, in
+    QC mode, {pre_prepare, prepare_qc} where the BLS aggregate replaces
+    the 2f+1 embedded votes. Returns (pp, prepares, ed25519 items,
+    quorum certs still needing their pairing check)."""
     if not isinstance(proof, dict):
         return None
     pp = _decode(proof.get("pre_prepare"), PrePrepare)
@@ -95,14 +100,33 @@ def validate_prepared_proof(
         return None
     if PrePrepare.block_digest(pp.block) != pp.digest:
         return None
-    raw_prepares = proof.get("prepares")
-    if not isinstance(raw_prepares, list) or len(raw_prepares) > cfg.n:
-        return None
     items: List[BatchItem] = []
     it = _sig_item(cfg, pp)
     if it is None:
         return None
     items.append(it)
+
+    if "prepare_qc" in proof:
+        if not cfg.qc_mode:
+            return None
+        cert = _decode(proof.get("prepare_qc"), QuorumCert)
+        if cert is None or cert.phase != "prepare":
+            return None
+        if (cert.view, cert.seq, cert.digest) != (pp.view, pp.seq, pp.digest):
+            return None
+        if len(cert.signers) < cfg.quorum or len(set(cert.signers)) != len(
+            cert.signers
+        ):
+            return None
+        if any(s not in cfg.replica_ids for s in cert.signers):
+            return None
+        # the aggregate IS the certificate: no per-vote ed25519 items;
+        # the pairing check runs off-loop on the returned cert
+        return pp, [], items, [cert]
+
+    raw_prepares = proof.get("prepares")
+    if not isinstance(raw_prepares, list) or len(raw_prepares) > cfg.n:
+        return None
     prepares: List[Prepare] = []
     senders = set()
     for rd in raw_prepares:
@@ -119,14 +143,15 @@ def validate_prepared_proof(
         prepares.append(p)
     if len(prepares) < cfg.quorum:
         return None
-    return pp, prepares, items
+    return pp, prepares, items, []
 
 
 def validate_view_change(
     cfg, msg: ViewChange, current_view_floor: int = 0
-) -> Optional[Tuple[Dict[int, Tuple[PrePrepare, List[Prepare]]], List[Checkpoint], List[BatchItem]]]:
+) -> Optional[Tuple[Dict[int, Tuple[PrePrepare, List[Prepare]]], List[Checkpoint], List[BatchItem], List[QuorumCert]]]:
     """Structural check of one VIEW-CHANGE; returns (prepared-by-seq,
-    checkpoint proof msgs, nested sig items) or None."""
+    checkpoint proof msgs, nested ed25519 sig items, quorum certs whose
+    pairing checks the caller must still run) or None."""
     if msg.sender not in cfg.replica_ids:
         return None
     if msg.new_view <= current_view_floor:
@@ -161,18 +186,20 @@ def validate_view_change(
     if len(msg.prepared_proofs) > cfg.watermark_window:
         return None
     prepared: Dict[int, Tuple[PrePrepare, List[Prepare]]] = {}
+    qcs: List[QuorumCert] = []
     for proof in msg.prepared_proofs:
         res = validate_prepared_proof(
             cfg, proof, msg.stable_seq, msg.stable_seq + cfg.watermark_window
         )
         if res is None:
             return None
-        pp, prepares, pitems = res
+        pp, prepares, pitems, pqcs = res
         if pp.seq in prepared or pp.view >= msg.new_view:
             return None
         prepared[pp.seq] = (pp, prepares)
         items.extend(pitems)
-    return prepared, cps, items
+        qcs.extend(pqcs)
+    return prepared, cps, items, qcs
 
 
 def compute_o_set(
@@ -207,15 +234,17 @@ def compute_o_set(
 
 def validate_new_view(
     cfg, msg: NewView
-) -> Optional[Tuple[Dict[str, ViewChange], List[BatchItem]]]:
+) -> Optional[Tuple[Dict[str, ViewChange], List[BatchItem], List[QuorumCert]]]:
     """Structural check of NEW-VIEW: the 2f+1 VC certificate plus the
-    re-issued pre-prepares, which must equal the recomputed O-set."""
+    re-issued pre-prepares, which must equal the recomputed O-set.
+    Returns (vcs, ed25519 items, pending quorum-cert pairing checks)."""
     if msg.sender != cfg.primary(msg.new_view):
         return None
     if not isinstance(msg.viewchange_proof, list) or len(msg.viewchange_proof) > cfg.n:
         return None
     vcs: Dict[str, ViewChange] = {}
     items: List[BatchItem] = []
+    qcs: List[QuorumCert] = []
     for rd in msg.viewchange_proof:
         vc = _decode(rd, ViewChange)
         if vc is None or vc.new_view != msg.new_view or vc.sender in vcs:
@@ -223,12 +252,13 @@ def validate_new_view(
         res = validate_view_change(cfg, vc)
         if res is None:
             return None
-        _, _, vitems = res
+        _, _, vitems, vqcs = res
         it = _sig_item(cfg, vc)
         if it is None:
             return None
         items.append(it)
         items.extend(vitems)
+        qcs.extend(vqcs)
         vcs[vc.sender] = vc
     if len(vcs) < cfg.quorum:
         return None
@@ -257,7 +287,7 @@ def validate_new_view(
             if it is None:
                 return None
             items.append(it)
-    return vcs, items
+    return vcs, items, qcs
 
 
 # ---------------------------------------------------------------------------
@@ -297,11 +327,16 @@ class ViewChanger:
             self._timer = loop.call_later(self._timeout, self._expired)
 
     def reset(self) -> None:
-        """Progress was made: disarm, re-arm if work remains."""
+        """Progress was made: reset the backoff, re-arm if work remains."""
+        self._timeout = self.r.cfg.view_timeout  # progress resets backoff
+        self._rearm_only()
+
+    def _rearm_only(self) -> None:
+        """Re-arm at the CURRENT (possibly backed-off) timeout without
+        treating the event as progress."""
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
-        self._timeout = self.r.cfg.view_timeout  # progress resets backoff
         if self.r.has_outstanding_work():
             self.arm()
 
@@ -385,6 +420,20 @@ class ViewChanger:
             prepared_proofs=proofs,
         )
 
+    async def _verify_qcs(self, qcs) -> bool:
+        """Pairing-check every quorum cert embedded in a certificate,
+        off-loop and concurrently (independent ~0.8 s pairings; results
+        memoized process-wide in consensus/qc.py)."""
+        if not qcs:
+            return True
+        results = await asyncio.gather(
+            *(
+                asyncio.to_thread(qc_mod.verify_qc, self.r.cfg, cert)
+                for cert in qcs
+            )
+        )
+        return all(results)
+
     # -- receiving ------------------------------------------------------
 
     async def on_view_change(self, msg: ViewChange) -> None:
@@ -401,10 +450,13 @@ class ViewChanger:
         if res is None:
             r.metrics["bad_viewchange"] += 1
             return
+        if not await self._verify_qcs(res[3]):
+            r.metrics["bad_viewchange_qc"] += 1
+            return
         store = self.vc_store.setdefault(msg.new_view, {})
         store[msg.sender] = msg
         # adopt the highest checkpoint the committee proves (state catch-up)
-        _, cps, _ = res
+        _, cps, _, _ = res
         for cp in cps:
             await r.on_checkpoint_msg(cp)
 
@@ -466,7 +518,10 @@ class ViewChanger:
         if res is None:
             r.metrics["bad_newview"] += 1
             return
-        vcs, _ = res
+        if not await self._verify_qcs(res[2]):
+            r.metrics["bad_newview_qc"] += 1
+            return
+        vcs, _, _ = res
         h, o_set = compute_o_set(r.cfg, vcs, msg.new_view)
         # catch up on checkpoints the certificate proves
         for vc in vcs.values():
@@ -483,8 +538,14 @@ class ViewChanger:
         self.in_view_change = False
         self.target_view = new_view
         self.vc_store = {v: s for v, s in self.vc_store.items() if v > new_view}
-        self._timeout = r.cfg.view_timeout
-        self.reset()
+        # NOTE: the backoff timeout is deliberately NOT reset here — only
+        # actual request progress resets it (reset() via _execute_ready).
+        # Resetting on install lets a slow-but-correct view (e.g. QC
+        # pairing latency > base timeout) be torn down forever: install,
+        # re-arm at base, expire before the first commit, repeat — a
+        # self-inflicted view-change storm. Castro-Liskov doubles per
+        # attempt and resets on completed requests only.
+        self._rearm_only()
         r.metrics["views_installed"] += 1
 
         max_seq = r.stable_seq

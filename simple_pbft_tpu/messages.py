@@ -116,12 +116,13 @@ class Message:
             if want is str and not isinstance(v, str):
                 raise ValueError(f"{cls.KIND}.{f.name}: expected str")
             if want is None:
-                # every list-typed field is List[Dict[...]] on the wire
+                elem = str if f.type.startswith("List[str]") else dict
                 if not isinstance(v, list) or not all(
-                    isinstance(e, dict) for e in v
+                    isinstance(e, elem) for e in v
                 ):
                     raise ValueError(
-                        f"{cls.KIND}.{f.name}: expected list of objects"
+                        f"{cls.KIND}.{f.name}: expected list of "
+                        f"{elem.__name__}"
                     )
             kw[f.name] = v
         return cls(**kw)
@@ -247,25 +248,63 @@ class PrePrepare(Message):
 @dataclass
 class Prepare(Message):
     """Phase-2 vote. Reference: VoteMsg with MsgType=PrepareMsg
-    (pbft_msg_types.go:25-38)."""
+    (pbft_msg_types.go:25-38).
+
+    In QC mode (config.qc_mode) the vote additionally carries
+    ``bls_share`` — a hex G1 BLS signature over ``qc_payload(...)`` —
+    and goes only to the primary, which aggregates 2f+1 shares into a
+    ``QuorumCert``."""
 
     KIND: ClassVar[str] = "prepare"
 
     view: int = 0
     seq: int = 0
     digest: str = ""
+    bls_share: str = ""
 
 
 @dataclass
 class Commit(Message):
     """Phase-3 vote. Reference: VoteMsg with MsgType=CommitMsg
-    (pbft_msg_types.go:25-38)."""
+    (pbft_msg_types.go:25-38). ``bls_share`` as in Prepare."""
 
     KIND: ClassVar[str] = "commit"
 
     view: int = 0
     seq: int = 0
     digest: str = ""
+    bls_share: str = ""
+
+
+def qc_payload(phase: str, view: int, seq: int, digest: str) -> bytes:
+    """The byte string every BLS share and aggregate signs for one QC."""
+    return canonical_json(
+        {"digest": digest, "phase": phase, "seq": seq, "view": view}
+    )
+
+
+@dataclass
+class QuorumCert(Message):
+    """Aggregate certificate for one phase of one slot (QC mode).
+
+    2f+1 distinct replicas' BLS shares over ``qc_payload(phase, view,
+    seq, digest)``, aggregated to one G1 point — the whole certificate
+    verifies with ONE pairing check (BASELINE config 4), and it replaces
+    the O(n^2) all-to-all vote broadcast with primary-relayed O(n)
+    messages. Self-certifying: any replica may relay it.
+    """
+
+    KIND: ClassVar[str] = "qc"
+
+    phase: str = ""  # "prepare" | "commit"
+    view: int = 0
+    seq: int = 0
+    digest: str = ""
+    signers: List[str] = field(default_factory=list)
+    agg_sig: str = ""  # hex, 96-byte G1 point
+
+    def payload(self) -> bytes:
+        return qc_payload(self.phase, self.view, self.seq, self.digest)
 
 
 # ---------------------------------------------------------------------------

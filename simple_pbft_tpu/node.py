@@ -63,7 +63,10 @@ async def run_node(args) -> None:
     await stop.wait()
     await replica.stop()
     await transport.stop()
-    logging.info("%s: metrics %s", args.id, dict(replica.metrics))
+    # shutdown dump: counters + sweep/verify/commit histograms as one JSON
+    # line — the observability the perf work steers by (VERDICT weak #8)
+    logging.info("%s: stats %s", args.id, replica.stats.dump(replica.metrics))
+    logging.info("%s: transport %s", args.id, dict(transport.metrics))
 
 
 def main() -> None:
@@ -81,11 +84,20 @@ def main() -> None:
         help="signature verification backend",
     )
     ap.add_argument("--log-level", default="INFO")
-    args = ap.parse_args()
-    logging.basicConfig(
-        level=args.log_level,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    ap.add_argument(
+        "--log-dir",
+        default=None,
+        help="per-node rotating log file directory (default: "
+        "<deploy-dir>/log, matching the reference's zap/lumberjack "
+        "layout; empty string disables the file sink)",
     )
+    args = ap.parse_args()
+    from .logutil import setup_node_logging
+
+    log_dir = args.log_dir
+    if log_dir is None:
+        log_dir = os.path.join(args.deploy_dir, "log")
+    setup_node_logging(args.id, log_dir or None, level=args.log_level)
     asyncio.run(run_node(args))
 
 

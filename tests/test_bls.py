@@ -1,0 +1,200 @@
+"""BLS12-381 + quorum-certificate tests.
+
+The pairing implementation is anchored ALGEBRAICALLY (no external test
+vectors exist in this environment): generator orders, tower-field inverse
+round-trips, untwist-lands-on-curve, bilinearity e(aP,bQ) = e(P,Q)^{ab},
+and image order r. A wrong constant or formula breaks at least one of
+these. On top: the signature scheme's accept/reject matrix, aggregation
+soundness, proof-of-possession, and the QC helpers' structural checks.
+
+Pairings cost ~0.8 s each in pure Python — tests budget them carefully
+(the process-wide memo in consensus/qc.py is also under test).
+"""
+
+import random
+
+import pytest
+
+from simple_pbft_tpu.consensus import qc as qc_mod
+from simple_pbft_tpu.crypto import bls
+from simple_pbft_tpu.messages import QuorumCert, qc_payload
+
+rng = random.Random(42)
+
+
+# ---------------------------------------------------------------------------
+# field towers
+# ---------------------------------------------------------------------------
+
+
+def _rand_f2():
+    return (rng.randrange(bls.P), rng.randrange(bls.P))
+
+
+def _rand_f6():
+    return (_rand_f2(), _rand_f2(), _rand_f2())
+
+
+def _rand_f12():
+    return (_rand_f6(), _rand_f6())
+
+
+def test_tower_inverses_roundtrip():
+    for _ in range(3):
+        x2 = _rand_f2()
+        assert bls.f2_mul(x2, bls.f2_inv(x2)) == bls.F2_ONE
+        x6 = _rand_f6()
+        assert bls.f6_mul(x6, bls.f6_inv(x6)) == bls.F6_ONE
+        x12 = _rand_f12()
+        assert bls.f12_mul(x12, bls.f12_inv(x12)) == bls.F12_ONE
+
+
+def test_f6_v_mul_consistent():
+    # multiplying by v via the rotation helper == multiplying by (0,1,0)
+    x = _rand_f6()
+    v = (bls.F2_ZERO, bls.F2_ONE, bls.F2_ZERO)
+    assert bls.f6_mul_v(x) == bls.f6_mul(x, v)
+
+
+# ---------------------------------------------------------------------------
+# curve + pairing algebra
+# ---------------------------------------------------------------------------
+
+
+def test_generators_on_curve_with_order_r():
+    assert bls.G1.is_on_curve(bls.G1_GEN)
+    assert bls.G2.is_on_curve(bls.G2_GEN)
+    assert bls.G1.mul_pt(bls.G1_GEN, bls.R_ORDER - 1) == bls.G1.neg_pt(bls.G1_GEN)
+    assert bls.G2.mul_pt(bls.G2_GEN, bls.R_ORDER - 1) == bls.G2.neg_pt(bls.G2_GEN)
+
+
+def test_untwist_lands_on_fp12_curve():
+    q = bls._untwist(bls.G2_GEN)
+    x, y = q
+    lhs = bls.f12_mul(y, y)
+    rhs = bls.f12_add_el(bls.f12_mul(bls.f12_mul(x, x), x), bls._embed_fp(4))
+    assert lhs == rhs
+
+
+def test_pairing_bilinearity():
+    e = bls.pairing(bls.G1_GEN, bls.G2_GEN)
+    assert e != bls.F12_ONE
+    assert bls.f12_pow(e, bls.R_ORDER) == bls.F12_ONE  # image order r
+    e23 = bls.pairing(
+        bls.G1.mul_pt(bls.G1_GEN, 2), bls.G2.mul_pt(bls.G2_GEN, 3)
+    )
+    assert e23 == bls.f12_pow(e, 6)
+
+
+def test_hash_to_g1_in_subgroup_and_deterministic():
+    p1 = bls.hash_to_g1(b"vote payload")
+    p2 = bls.hash_to_g1(b"vote payload")
+    assert p1 == p2
+    assert bls.G1.is_on_curve(p1)
+    assert bls._subgroup_check_g1(p1)
+    assert bls.hash_to_g1(b"other") != p1
+    # domain separation: same bytes, different tag -> different point
+    assert bls.hash_to_g1(b"vote payload", bls.DST_POP) != p1
+
+
+# ---------------------------------------------------------------------------
+# signature scheme
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return [bls.keygen(bytes([i + 1]) * 32) for i in range(4)]
+
+
+def test_sign_verify_reject_matrix(keys):
+    (sk0, pk0), (sk1, pk1) = keys[0], keys[1]
+    msg = b"commit view=1 seq=9"
+    sig = bls.sign(sk0, msg)
+    assert bls.verify(pk0, msg, sig)
+    assert not bls.verify(pk1, msg, sig)  # wrong key
+    assert not bls.verify(pk0, b"forged", sig)  # wrong msg
+    flipped = bytearray(sig)
+    flipped[5] ^= 1
+    assert not bls.verify(pk0, msg, bytes(flipped))  # corrupted point
+    assert not bls.verify(pk0, msg, b"\x00" * bls.G1_BYTES)  # infinity
+    assert not bls.verify(b"junk", msg, sig)  # malformed pubkey
+
+
+def test_aggregate_and_pop(keys):
+    msg = b"qc payload"
+    sigs = [bls.sign(sk, msg) for sk, _ in keys]
+    pks = [pk for _, pk in keys]
+    agg = bls.aggregate_signatures(sigs)
+    assert bls.verify_aggregate(pks, msg, agg)
+    assert not bls.verify_aggregate(pks[:3], msg, agg)  # signer set mismatch
+    assert not bls.verify_aggregate(pks, b"other", agg)
+    assert not bls.verify_aggregate([], msg, agg)
+    sk0, pk0 = keys[0]
+    pop = bls.pop_prove(sk0, pk0)
+    assert bls.pop_verify(pk0, pop)
+    assert not bls.pop_verify(keys[1][1], pop)
+
+
+# ---------------------------------------------------------------------------
+# QC helpers
+# ---------------------------------------------------------------------------
+
+
+class _Cfg:
+    def __init__(self, keys):
+        self.bls = {f"r{i}": pk for i, (_, pk) in enumerate(keys)}
+        self.quorum = 3
+        self.replica_ids = tuple(sorted(self.bls))
+
+    def bls_pubkey(self, nid):
+        return self.bls.get(nid)
+
+
+def test_qc_build_verify_and_cache(keys):
+    cfg = _Cfg(keys)
+    shares = {
+        f"r{i}": qc_mod.sign_share(sk, "prepare", 2, 7, "d" * 64)
+        for i, (sk, _) in enumerate(keys[:3])
+    }
+    cert = qc_mod.build_qc("prepare", 2, 7, "d" * 64, shares, cfg.quorum)
+    assert cert is not None
+    assert qc_mod.verify_qc(cfg, cert)
+    # memo: second call must hit the cache (same verdict, no recompute)
+    assert qc_mod.verify_qc(cfg, cert)
+    # structural rejects
+    assert not qc_mod.verify_qc(
+        cfg, QuorumCert(phase="bogus", view=2, seq=7, digest="d" * 64,
+                        signers=cert.signers, agg_sig=cert.agg_sig)
+    )
+    assert not qc_mod.verify_qc(
+        cfg, QuorumCert(phase="prepare", view=2, seq=7, digest="d" * 64,
+                        signers=["r0", "r0", "r1"], agg_sig=cert.agg_sig)
+    )
+    assert not qc_mod.verify_qc(
+        cfg, QuorumCert(phase="prepare", view=2, seq=7, digest="d" * 64,
+                        signers=["r0", "r1", "rX"], agg_sig=cert.agg_sig)
+    )
+    # tampered digest -> pairing fails
+    bad = QuorumCert(phase="prepare", view=2, seq=7, digest="e" * 64,
+                     signers=cert.signers, agg_sig=cert.agg_sig)
+    assert not qc_mod.verify_qc(cfg, bad)
+
+
+def test_bisect_bad_shares(keys):
+    cfg = _Cfg(keys)
+    good = {
+        f"r{i}": qc_mod.sign_share(sk, "commit", 0, 3, "a" * 64)
+        for i, (sk, _) in enumerate(keys[:3])
+    }
+    shares = dict(good)
+    shares["r1"] = qc_mod.sign_share(keys[1][0], "commit", 0, 4, "a" * 64)  # wrong seq
+    surviving = qc_mod.bisect_bad_shares(cfg, "commit", 0, 3, "a" * 64, shares)
+    assert set(surviving) == {"r0", "r2"}
+
+
+def test_qc_payload_is_canonical():
+    a = qc_payload("prepare", 1, 2, "d")
+    b = qc_payload("prepare", 1, 2, "d")
+    assert a == b
+    assert qc_payload("commit", 1, 2, "d") != a

@@ -1,0 +1,140 @@
+"""QC-mode committees: BLS aggregate certificates driving consensus.
+
+BASELINE config 4: instead of O(n^2) vote broadcasts, votes carry BLS
+shares to the primary, which aggregates 2f+1 into a QuorumCert verified
+with ONE pairing check. Covers: the happy path, a Byzantine share
+corrupting the aggregate (bisection), primary-crash failover with
+QC-based prepared certificates, and a large committee committing with
+one aggregate check per QC.
+"""
+
+import asyncio
+
+import pytest
+
+from simple_pbft_tpu.committee import LocalCommittee
+
+
+def run(coro, timeout=300):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def test_qc_committee_commits():
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1, qc_mode=True, view_timeout=30.0)
+        com.clients[0].request_timeout = 30.0
+        com.start()
+        try:
+            assert await com.clients[0].submit("put k 1") == "ok"
+            rs = await asyncio.gather(
+                *(com.clients[0].submit(f"put q{i} {i}") for i in range(4))
+            )
+            assert rs == ["ok"] * 4
+            assert await com.clients[0].submit("get k") == "1"
+            await asyncio.sleep(0.5)
+        finally:
+            await com.stop()
+        for r in com.replicas:
+            assert r.metrics["committed_requests"] >= 6
+        primary = com.replica("r0")
+        assert primary.metrics["qcs_formed"] >= 4  # 2 phases x >= 2 blocks
+        # backups never reach vote quorums locally — QCs drove them
+        for r in com.replicas[1:]:
+            assert r.metrics["qcs_formed"] == 0
+
+    run(scenario())
+
+
+def test_qc_byzantine_share_bisected():
+    """A replica that ships garbage BLS shares must not stall the
+    committee: the primary's aggregate self-check fails, bisection drops
+    the bad share, and the quorum forms from the honest 2f+1."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1, qc_mode=True, view_timeout=60.0)
+        com.clients[0].request_timeout = 60.0
+        # r3 signs shares for the WRONG payload (valid curve point, valid
+        # ed25519 envelope — only the pairing can catch it)
+        evil = com.replica("r3")
+        from simple_pbft_tpu.consensus import qc as qc_mod
+
+        orig = qc_mod.sign_share
+        calls = {"n": 0}
+
+        def corrupt(sk, phase, view, seq, digest):
+            if sk == evil.bls_sk:
+                calls["n"] += 1
+                return orig(sk, phase, view + 1000, seq, digest)
+            return orig(sk, phase, view, seq, digest)
+
+        qc_mod.sign_share = corrupt
+        com.start()
+        try:
+            assert await com.clients[0].submit("put z 9") == "ok"
+            await asyncio.sleep(0.5)
+        finally:
+            qc_mod.sign_share = orig
+            await com.stop()
+        primary = com.replica("r0")
+        assert calls["n"] >= 1  # the corrupt path actually ran
+        # either the bad share landed in an aggregate (bisected) or the
+        # primary formed the quorum from the honest 3 before r3's share
+        assert (
+            primary.metrics.get("qc_bad_shares", 0) >= 1
+            or primary.metrics["qcs_formed"] >= 2
+        )
+
+    run(scenario())
+
+
+def test_qc_failover_preserves_state():
+    """Kill the primary mid-run: the committee view-changes using
+    QC-based prepared certificates and the new view serves old state."""
+
+    async def scenario():
+        # timers must dominate the ~1 s/pairing pure-Python QC latency on
+        # a busy single-core host or the failover retries before it lands
+        com = LocalCommittee.build(n=4, clients=1, qc_mode=True, view_timeout=4.0)
+        com.clients[0].request_timeout = 8.0
+        com.start()
+        try:
+            assert await com.clients[0].submit("put a 1") == "ok"
+            com.replica("r0").kill()
+            assert await com.clients[0].submit("put b 2", retries=20) == "ok"
+            assert await com.clients[0].submit("get a", retries=20) == "1"
+            views = {x.id: x.view for x in com.replicas if x._running}
+            assert all(v >= 1 for v in views.values()), views
+        finally:
+            await com.stop()
+
+    run(scenario())
+
+
+@pytest.mark.slow
+def test_qc_large_committee_single_aggregate_check():
+    """BASELINE config 4 shape: a large committee commits a block where
+    the whole prepare/commit quorum is certified by ONE aggregate each.
+    n=32 keeps CI wall-clock sane (the BLS key generation is ~40 ms/key
+    and the in-process simulation serializes all replicas on one core);
+    bench_consensus --qc runs the full n=256."""
+
+    async def scenario():
+        n = 32
+        com = LocalCommittee.build(
+            n=n, clients=1, qc_mode=True, view_timeout=120.0
+        )
+        com.clients[0].request_timeout = 120.0
+        com.start()
+        try:
+            assert await com.clients[0].submit("put big 1") == "ok"
+            await asyncio.sleep(1.0)
+        finally:
+            await com.stop()
+        primary = com.replica("r0")
+        assert primary.metrics["qcs_formed"] == 2  # one per phase
+        committed = sum(
+            1 for r in com.replicas if r.metrics["committed_requests"] >= 1
+        )
+        assert committed >= com.cfg.quorum
+
+    run(scenario(), timeout=600)

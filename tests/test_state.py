@@ -164,3 +164,52 @@ def test_preprepare_from_non_primary_rejected():
     assert [type(a) for a in inst.on_pre_prepare(make_preprepare(sender="r0"))] == [
         SendPrepare
     ]
+
+
+class TestQcModeInstance:
+    """State-machine-level QC-mode safety (the two hard cases from
+    review: QC-before-pre-prepare orderings)."""
+
+    def _qc(self, phase, digest):
+        from simple_pbft_tpu.messages import QuorumCert
+
+        return QuorumCert(
+            phase=phase, view=0, seq=1, digest=digest,
+            signers=["r0", "r1", "r2"], agg_sig="ab",
+        )
+
+    def test_equivocation_after_commit_qc_rejected(self):
+        """A commit QC fixes the slot's digest; an equivocating primary's
+        later pre-prepare for a DIFFERENT block must not execute."""
+        from simple_pbft_tpu.messages import PrePrepare
+        from simple_pbft_tpu.consensus.state import Instance
+
+        inst = Instance(view=0, seq=1, quorum=3, primary="r0", qc_mode=True)
+        committed_digest = "d" * 64
+        assert inst.on_commit_qc(self._qc("commit", committed_digest)) == []
+        evil_block = [{"kind": "request", "sender": "cX", "client_id": "cX",
+                       "timestamp": 1, "operation": "evil", "sig": "00"}]
+        pp = PrePrepare(view=0, seq=1,
+                        digest=PrePrepare.block_digest(evil_block),
+                        block=evil_block)
+        pp.sender = "r0"
+        assert inst.on_pre_prepare(pp) == []
+        assert inst.block is None and not inst.executed
+
+    def test_commit_share_waits_for_preprepare(self):
+        """A prepare QC alone must NOT emit the commit share — the replica
+        could not prove the slot in a view change (quorum intersection).
+        The share goes out once the pre-prepare lands."""
+        from simple_pbft_tpu.messages import PrePrepare
+        from simple_pbft_tpu.consensus.state import Instance, SendCommit
+
+        inst = Instance(view=0, seq=1, quorum=3, primary="r0", qc_mode=True)
+        block = []
+        digest = PrePrepare.block_digest(block)
+        acts = inst.on_prepare_qc(self._qc("prepare", digest))
+        assert not any(isinstance(a, SendCommit) for a in acts)
+        pp = PrePrepare(view=0, seq=1, digest=digest, block=block)
+        pp.sender = "r0"
+        acts = inst.on_pre_prepare(pp)
+        assert any(isinstance(a, SendCommit) for a in acts)
+        assert inst.prepared_proof() is not None

@@ -289,7 +289,7 @@ def test_checkpoint_proof_carries_watermark():
     vc = _signed_vc(cfg, keys, "r1", 1, stable_seq=64, cps=cps)
     res = validate_view_change(cfg, vc)
     assert res is not None
-    _, cp_msgs, items = res
+    _, cp_msgs, items, _qcs = res
     assert len(cp_msgs) == 3 and len(items) == 3
 
 
