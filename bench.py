@@ -115,6 +115,9 @@ def main() -> None:
     fe.use_mul_impl(mul_impl)  # must precede any jit trace
 
     from simple_pbft_tpu.ops import comb
+
+    accum_impl = os.environ.get("BENCH_ACCUM", "xla")
+    comb.use_accum_impl(accum_impl)
     from simple_pbft_tpu.crypto import ed25519_cpu as ref
     from simple_pbft_tpu.crypto.verifier import BatchItem
     from simple_pbft_tpu.crypto.tpu_verifier import (
@@ -232,6 +235,7 @@ def main() -> None:
         platform=platform,
         mode=mode,
         mul=mul_impl,
+        accum=accum_impl,
     )
 
 

@@ -46,6 +46,24 @@ def _emit(rec: dict) -> None:
     os.write(1, (json.dumps(rec) + "\n").encode())
 
 
+def _start_watchdog(budget: float) -> None:
+    """Hard wall-clock bound: dump every thread's stack to stderr and
+    exit. A wedged scenario (e.g. a certificate-validation pile-up) must
+    produce a diagnosable artifact, not an eternal process."""
+    import faulthandler
+    import threading
+    import time as _t
+
+    def fire():
+        _t.sleep(budget)
+        print(f"WATCHDOG: wall clock exceeded {budget:.0f}s", file=sys.stderr)
+        faulthandler.dump_traceback(file=sys.stderr)
+        _emit({"config": "watchdog-timeout", "budget_s": budget})
+        os._exit(3)
+
+    threading.Thread(target=fire, daemon=True).start()
+
+
 async def _pump(client, stop_at: float, latencies: List[float], errors: List[int]):
     """One closed-loop driver: keep exactly one request in flight, record
     per-request latency. Concurrency comes from running many of these."""
@@ -70,6 +88,7 @@ async def run_config(
     batch: int,
     storm: bool = False,
     qc_mode: bool = False,
+    view_timeout: float = 0.0,
 ) -> dict:
     from simple_pbft_tpu.committee import LocalCommittee
     from simple_pbft_tpu.crypto.tpu_verifier import BUCKETS, TpuVerifier
@@ -112,7 +131,7 @@ async def run_config(
         clients=n_clients,
         verifier_factory=factory,
         max_batch=batch,
-        view_timeout=30.0 if not storm else 3.0,
+        view_timeout=view_timeout or (30.0 if not storm else 3.0),
         checkpoint_interval=64,
         watermark_window=1024,
         qc_mode=qc_mode,
@@ -193,7 +212,14 @@ async def main() -> None:
     ap.add_argument("--outstanding", type=int, default=128)
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--storm", action="store_true")
+    ap.add_argument(
+        "--view-timeout", type=float, default=0.0,
+        help="failover timer override; the storm default (3 s) assumes "
+        "view-change validation is fast — on a single-core host a 64-node "
+        "certificate takes seconds to check, so raise this accordingly",
+    )
     args = ap.parse_args()
+    _start_watchdog(float(os.environ.get("BENCH_CONSENSUS_TIMEOUT", "420")))
 
     ladder = {
         "1": dict(name="pbft-n4", n=4),
@@ -205,9 +231,12 @@ async def main() -> None:
     for key in args.configs.split(","):
         key = key.strip()
         if args.storm:
+            n = ladder[key]["n"] if key in ladder else 64
             rec = await run_config(
-                "viewchange-storm-n64", 64, args.seconds, args.clients,
+                f"viewchange-storm-n{n}", n, args.seconds, args.clients,
                 args.outstanding, args.verifier, args.batch, storm=True,
+                view_timeout=args.view_timeout,
+                qc_mode=ladder.get(key, {}).get("qc_mode", False),
             )
         else:
             if key not in ladder:

@@ -126,6 +126,8 @@ class Replica:
 
             self.bls_sk = bls.keygen(seed)[0]
         self._qc_sent: set = set()
+        # (sender, view) -> count of failed-pairing QCs (DoS rate bound)
+        self._qc_bad_by_sender: Dict[Tuple[str, int], int] = {}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -194,10 +196,15 @@ class Replica:
         no-op and the view wedges."""
         if self.relay_buffer or self.pending_requests:
             return True
+        # only CURRENT-view proposals count: an orphan pre-prepare from a
+        # dead view (primary crashed pre-quorum, O-set dropped the seq) is
+        # abandoned work — counting it would arm the failover timer
+        # forever with zero client work behind it
         return any(
             inst.pre_prepare is not None
             and not inst.executed
             and inst.seq > self.executed_seq
+            and inst.view == self.view
             for inst in self.instances.values()
         )
 
@@ -630,8 +637,19 @@ class Replica:
         if not self._in_window(msg.seq):
             self.metrics["out_of_window"] += 1
             return
+        # rate-bound the expensive pairing per sender: a faulty replica
+        # streaming distinct bogus aggregates (each a fresh ~0.8 s check,
+        # uncacheable by construction) must not monopolize the verify
+        # thread pool. Honest senders never accumulate failures.
+        bad_key = (msg.sender, msg.view)
+        if self._qc_bad_by_sender.get(bad_key, 0) >= 8:
+            self.metrics["qc_sender_muted"] += 1
+            return
         if not await asyncio.to_thread(qc_mod.verify_qc, self.cfg, msg):
             self.metrics["bad_qc"] += 1
+            self._qc_bad_by_sender[bad_key] = (
+                self._qc_bad_by_sender.get(bad_key, 0) + 1
+            )
             return
         inst = self._instance(msg.view, msg.seq)
         actions = (

@@ -421,18 +421,16 @@ class ViewChanger:
         )
 
     async def _verify_qcs(self, qcs) -> bool:
-        """Pairing-check every quorum cert embedded in a certificate,
-        off-loop and concurrently (independent ~0.8 s pairings; results
-        memoized process-wide in consensus/qc.py)."""
-        if not qcs:
-            return True
-        results = await asyncio.gather(
-            *(
-                asyncio.to_thread(qc_mod.verify_qc, self.r.cfg, cert)
-                for cert in qcs
-            )
-        )
-        return all(results)
+        """Pairing-check the quorum certs embedded in a certificate,
+        off-loop, SEQUENTIALLY with early exit: a Byzantine certificate
+        stuffed with fabricated aggregates must cost one pairing, not
+        watermark_window of them (~0.8 s each, pure Python). Honest
+        certificates' QCs are memoized process-wide (consensus/qc.py) so
+        the sequential pass is one pairing per genuinely-new cert."""
+        for cert in qcs:
+            if not await asyncio.to_thread(qc_mod.verify_qc, self.r.cfg, cert):
+                return False
+        return True
 
     # -- receiving ------------------------------------------------------
 
@@ -547,6 +545,10 @@ class ViewChanger:
         # attempt and resets on completed requests only.
         self._rearm_only()
         r.metrics["views_installed"] += 1
+        # old views' QC-sender mute counters are moot once the view moves
+        r._qc_bad_by_sender = {
+            k: v for k, v in r._qc_bad_by_sender.items() if k[1] >= new_view
+        }
 
         max_seq = r.stable_seq
         for rd in nv.pre_prepares:

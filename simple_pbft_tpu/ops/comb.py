@@ -193,13 +193,12 @@ def negate_rows(rows: jnp.ndarray) -> jnp.ndarray:
     )
 
 
-def madd(p: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
-    """Mixed add: extended (4, 17, ...) + packed Niels rows (ROW, ...).
-
-    ref10-style ge_madd — 7 field muls. Same group law as
-    edwards.point_add with Z2 = 1 and the Niels components precomputed.
-    """
-    x1, y1, z1, t1 = p[0], p[1], p[2], p[3]
+def _madd_tuple(x1, y1, z1, t1, rows):
+    """Mixed add on coordinate tuples: extended (17, ...) x4 + packed
+    Niels rows (ROW, ...). ref10-style ge_madd — 7 field muls. Same group
+    law as edwards.point_add with Z2 = 1 and the Niels components
+    precomputed. Tuple form so the Pallas loop carries register-resident
+    coordinates without stack/unstack churn."""
     ypx, ymx, xy2d = _row_niels(rows)
     a = fe.mul(fe.add(y1, x1), ypx)
     b = fe.mul(fe.sub(y1, x1), ymx)
@@ -209,9 +208,13 @@ def madd(p: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
     f = fe.sub(d, c)
     g = fe.add(d, c)
     h = fe.add(a, b)
-    return jnp.stack(
-        [fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h)], axis=0
-    )
+    return fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h)
+
+
+def madd(p: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
+    """Mixed add: extended (4, 17, ...) + packed Niels rows (ROW, ...)."""
+    x, y, z, t = _madd_tuple(p[0], p[1], p[2], p[3], rows)
+    return jnp.stack([x, y, z, t], axis=0)
 
 
 _IDENT_LIMBS: Optional[np.ndarray] = None
@@ -284,16 +287,87 @@ def fused_accumulate(
 
     s_nibbles, k_nibbles: (NPOS, B) int32. row_base: (B,) int32 =
     key_index * NPOS * FWINDOW. f_flat: (n_keys*NPOS*FWINDOW, ROW).
+
+    The madd loop runs either as plain XLA (fori_loop) or as a Pallas
+    kernel that keeps the accumulator and every field-mul intermediate in
+    VMEM across all 64 positions (`use_accum_impl`).
     """
     pos = jnp.arange(NPOS, dtype=jnp.int32)[:, None]
     idx = row_base[None, :] + pos * FWINDOW + s_nibbles * WINDOW + k_nibbles
     rows_all = _gather_rows(f_flat, idx)  # (NPOS, ROW, B)
+    if ACCUM_IMPL == "pallas":
+        return _madd_loop_pallas(rows_all)
     acc0 = _ident_like(s_nibbles[0])
 
     def body(i, acc):
         return madd(acc, rows_all[i])
 
     return lax.fori_loop(0, NPOS, body, acc0)
+
+
+# ---------------------------------------------------------------------------
+# Pallas madd-loop: the whole 64-position accumulation as ONE kernel.
+#
+# The XLA fori_loop materializes the (4, 17, B) accumulator in HBM every
+# iteration and streams each field-mul intermediate through HBM when the
+# fusion boundary falls badly. The Pallas kernel tiles the batch, holds the
+# four coordinates in VMEM/vector registers across all 64 madds, and only
+# the gathered table rows stream in — per-item HBM traffic drops to the
+# 64 x 256-byte rows it can't avoid.
+# ---------------------------------------------------------------------------
+
+ACCUM_IMPL = "xla"
+PALLAS_TILE = 256  # batch lanes per kernel program (rows block = 4 MiB)
+
+
+def use_accum_impl(name: str) -> None:
+    """Select the fused-accumulate implementation ('xla' or 'pallas')
+    BEFORE any kernel is jitted — jit traces capture the choice."""
+    global ACCUM_IMPL
+    assert name in ("xla", "pallas"), name
+    ACCUM_IMPL = name
+
+
+def _madd_loop_kernel(rows_ref, out_ref):
+    """Pallas body: rows_ref (NPOS, ROW, T) VMEM block -> out_ref
+    (4*NLIMB, T) — the accumulated [S]B + [k](−A) in extended coords."""
+    n = fe.NLIMB
+    tile = out_ref.shape[-1]
+    # identity point (0, 1, 1, 0): built from scalars via iota so the
+    # kernel captures no array constants (a Pallas requirement)
+    limb0 = lax.broadcasted_iota(jnp.int32, (n, tile), 0) == 0
+    zero = jnp.zeros((n, tile), jnp.int32)
+    one = jnp.where(limb0, 1, 0)
+
+    def body(i, acc):
+        return _madd_tuple(*acc, rows_ref[i])
+
+    x, y, z, t = lax.fori_loop(0, NPOS, body, (zero, one, one, zero))
+    out_ref[0 * n : 1 * n] = x
+    out_ref[1 * n : 2 * n] = y
+    out_ref[2 * n : 3 * n] = z
+    out_ref[3 * n : 4 * n] = t
+
+
+def _madd_loop_pallas(rows_all: jnp.ndarray) -> jnp.ndarray:
+    """(NPOS, ROW, B) gathered rows -> (4, 17, B) accumulator."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    b = rows_all.shape[-1]
+    tile = min(PALLAS_TILE, b)
+    assert b % tile == 0, (b, tile)
+    out = pl.pallas_call(
+        _madd_loop_kernel,
+        out_shape=jax.ShapeDtypeStruct((4 * fe.NLIMB, b), jnp.int32),
+        grid=(b // tile,),
+        in_specs=[
+            pl.BlockSpec((NPOS, ROW, tile), lambda i: (0, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((4 * fe.NLIMB, tile), lambda i: (0, i)),
+        interpret=jax.default_backend() != "tpu",
+    )(rows_all)
+    return out.reshape(4, fe.NLIMB, b)
 
 
 def _interleave(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

@@ -178,14 +178,22 @@ def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return _carry_pass(a + b)
 
 
+def _two_p(x: jnp.ndarray) -> jnp.ndarray:
+    """2p as limbs, built from scalars via iota/where: only limb 0
+    differs from 2*MASK. Constructed (not embedded as a concrete array)
+    so Pallas kernels using sub/neg don't capture array constants."""
+    i = lax.broadcasted_iota(jnp.int32, (NLIMB,) + (1,) * (x.ndim - 1), 0)
+    return jnp.where(i == 0, 2 * (2**RADIX - 19), 2 * MASK)
+
+
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """a - b, computed as a + 2p - b to stay nonnegative (< 2^17 per
     limb, one carry pass)."""
-    return _carry_pass(a + bcast(TWO_P, a) - b)
+    return _carry_pass(a + _two_p(a) - b)
 
 
 def neg(a: jnp.ndarray) -> jnp.ndarray:
-    return _carry_pass(bcast(TWO_P, a) - a)
+    return _carry_pass(_two_p(a) - a)
 
 
 def mul_padacc(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

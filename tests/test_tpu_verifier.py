@@ -186,3 +186,33 @@ def test_sharded_quorum_step():
     verdict, counts = np.asarray(verdict), np.asarray(counts)
     assert not verdict[0] and verdict[1:].all()
     assert counts.tolist() == [7, 8]  # one invalid vote lost from instance 0
+
+
+def test_pallas_accumulate_matches_xla():
+    """The Pallas madd-loop kernel (interpret mode on CPU) must agree
+    bit-for-bit with the XLA fori_loop path on the same batch."""
+    import jax.numpy as jnp
+
+    from simple_pbft_tpu.ops import comb
+    from simple_pbft_tpu.crypto.tpu_verifier import KeyBank, prepare_comb_batch
+
+    items = [_signed(i % 3, b"pallas %d" % i) for i in range(8)]
+    broken = bytearray(items[5].sig)
+    broken[9] ^= 2
+    items[5] = BatchItem(items[5].pubkey, items[5].msg, bytes(broken))
+
+    bank = KeyBank(mode="fused")
+    prep, _ = prepare_comb_batch(items, bank)
+    s_nib, k_nib, a_idx, r_y, r_sign, precheck = prep.arrays()
+    tables = bank.device_tables()
+    args = (jnp.asarray(s_nib), jnp.asarray(k_nib), jnp.asarray(a_idx),
+            tables, jnp.asarray(r_y), jnp.asarray(r_sign), jnp.asarray(precheck))
+    try:
+        comb.use_accum_impl("xla")
+        want = np.asarray(comb.fused_verify_kernel(*args))
+        comb.use_accum_impl("pallas")
+        got = np.asarray(comb.fused_verify_kernel(*args))
+    finally:
+        comb.use_accum_impl("xla")
+    assert want.tolist() == [True] * 5 + [False] + [True] * 2
+    assert got.tolist() == want.tolist()
