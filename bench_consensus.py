@@ -219,7 +219,14 @@ async def main() -> None:
         "certificate takes seconds to check, so raise this accordingly",
     )
     args = ap.parse_args()
-    _start_watchdog(float(os.environ.get("BENCH_CONSENSUS_TIMEOUT", "420")))
+    # watchdog scales with the requested ladder: measurement time plus
+    # generous per-config setup/teardown slack (large committees take tens
+    # of seconds to wind up on a small host); env var still overrides
+    n_configs = max(1, len([k for k in args.configs.split(",") if k.strip()]))
+    default_budget = n_configs * (args.seconds + 120.0) + 60.0
+    _start_watchdog(
+        float(os.environ.get("BENCH_CONSENSUS_TIMEOUT", str(default_budget)))
+    )
 
     ladder = {
         "1": dict(name="pbft-n4", n=4),
@@ -230,30 +237,29 @@ async def main() -> None:
     }
     for key in args.configs.split(","):
         key = key.strip()
+        if key not in ladder:
+            sys.exit(
+                f"unknown config {key!r}: valid are "
+                f"{sorted(ladder)} (config 5, the view-change storm, "
+                f"runs via --storm over one of these committee sizes)"
+            )
         if args.storm:
-            n = ladder[key]["n"] if key in ladder else 64
+            cfg = ladder[key]
             rec = await run_config(
-                f"viewchange-storm-n{n}", n, args.seconds, args.clients,
-                args.outstanding, args.verifier, args.batch, storm=True,
-                view_timeout=args.view_timeout,
-                qc_mode=ladder.get(key, {}).get("qc_mode", False),
+                f"viewchange-storm-n{cfg['n']}", cfg["n"], args.seconds,
+                args.clients, args.outstanding, args.verifier, args.batch,
+                storm=True, view_timeout=args.view_timeout,
+                qc_mode=cfg.get("qc_mode", False),
             )
         else:
-            if key not in ladder:
-                sys.exit(
-                    f"unknown config {key!r}: valid are "
-                    f"{sorted(ladder)} (config 5, the view-change storm, "
-                    f"runs via --storm)"
-                )
             cfg = ladder[key]
             rec = await run_config(
                 cfg["name"], cfg["n"], args.seconds, args.clients,
                 args.outstanding, args.verifier, args.batch,
+                view_timeout=args.view_timeout,
                 qc_mode=cfg.get("qc_mode", False),
             )
         _emit(rec)
-        if args.storm:
-            break
 
 
 if __name__ == "__main__":

@@ -18,17 +18,13 @@ import time
 
 from . import deploy
 from .client import Client
-from .transport.tcp import TcpTransport
+from .node import make_transport
 
 
 async def run_client(args) -> None:
     dep = deploy.load(os.path.join(args.deploy_dir, "committee.json"))
     seed = deploy.read_seed(args.deploy_dir, args.id)
-    transport = TcpTransport(
-        node_id=args.id,
-        listen_addr=dep.addr(args.id),
-        peers=dep.peers_for(args.id),
-    )
+    transport = make_transport(args.transport, args.id, dep)
     await transport.start()
     client = Client(
         client_id=args.id,
@@ -90,6 +86,7 @@ def main() -> None:
     ap.add_argument("--timeout", type=float, default=1.0)
     ap.add_argument("--retries", type=int, default=5)
     ap.add_argument("--print-results", type=int, default=10)
+    ap.add_argument("--transport", default="tcp", choices=["tcp", "grpc"])
     ap.add_argument("--log-level", default="WARNING")
     args = ap.parse_args()
     logging.basicConfig(level=args.log_level)

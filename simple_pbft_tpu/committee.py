@@ -72,9 +72,17 @@ class LocalCommittee:
 
         # concurrent: graceful stop drains each replica's pipeline (up to
         # ~10 s when certificate-heavy sweeps are mid-flight); serially a
-        # 64-node teardown could take minutes
-        await asyncio.gather(*(r.stop() for r in self.replicas))
-        await asyncio.gather(*(c.stop() for c in self.clients))
+        # 64-node teardown could take minutes. return_exceptions so one
+        # failing stop can't abandon the rest mid-teardown
+        results = await asyncio.gather(
+            *(r.stop() for r in self.replicas), return_exceptions=True
+        )
+        results += await asyncio.gather(
+            *(c.stop() for c in self.clients), return_exceptions=True
+        )
+        for exc in results:
+            if isinstance(exc, BaseException):
+                raise exc
 
     def replica(self, rid: str) -> Replica:
         return next(r for r in self.replicas if r.id == rid)

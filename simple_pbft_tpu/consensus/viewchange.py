@@ -545,10 +545,10 @@ class ViewChanger:
         # attempt and resets on completed requests only.
         self._rearm_only()
         r.metrics["views_installed"] += 1
-        # old views' QC-sender mute counters are moot once the view moves
-        r._qc_bad_by_sender = {
-            k: v for k, v in r._qc_bad_by_sender.items() if k[1] >= new_view
-        }
+        # old views' QC-sender mute counters are moot once the view moves;
+        # on_qc only records failures for the CURRENT view, so every key
+        # is from a view < new_view — clear the lot
+        r._qc_bad_by_sender.clear()
 
         max_seq = r.stable_seq
         for rd in nv.pre_prepares:

@@ -23,6 +23,7 @@ def main() -> None:
     ap.add_argument("-n", type=int, default=4, help="replica count")
     ap.add_argument("--load", type=int, default=16, help="client requests")
     ap.add_argument("--verifier", default="cpu")
+    ap.add_argument("--transport", default="tcp", choices=["tcp", "grpc"])
     ap.add_argument("--base-port", type=int, default=7000)
     ap.add_argument("--deploy-dir", default=None, help="reuse/keep a deployment dir")
     ap.add_argument("--keep", action="store_true", help="don't delete the deploy dir")
@@ -45,6 +46,7 @@ def main() -> None:
                         "--id", f"r{i}",
                         "--deploy-dir", deploy_dir,
                         "--verifier", args.verifier,
+                        "--transport", args.transport,
                     ],
                     env=env,
                 )
@@ -56,6 +58,7 @@ def main() -> None:
                 "--id", "c0",
                 "--deploy-dir", deploy_dir,
                 "--load", str(args.load),
+                "--transport", args.transport,
             ],
             env=env,
         )

@@ -20,6 +20,22 @@ from .crypto.verifier import CpuVerifier, InsecureVerifier, best_cpu_verifier
 from .transport.tcp import TcpTransport
 
 
+def make_transport(name: str, node_id: str, dep: "deploy.Deployment"):
+    """tcp (default, intra-host) or grpc (the DCN path, SURVEY.md §2.3)."""
+    cls = TcpTransport
+    if name == "grpc":
+        from .transport.grpc import GrpcTransport
+
+        cls = GrpcTransport
+    elif name != "tcp":
+        raise SystemExit(f"unknown transport: {name}")
+    return cls(
+        node_id=node_id,
+        listen_addr=dep.addr(node_id),
+        peers=dep.peers_for(node_id),
+    )
+
+
 def make_verifier(name: str):
     if name == "tpu":
         from .crypto.tpu_verifier import TpuVerifier
@@ -37,11 +53,7 @@ def make_verifier(name: str):
 async def run_node(args) -> None:
     dep = deploy.load(os.path.join(args.deploy_dir, "committee.json"))
     seed = deploy.read_seed(args.deploy_dir, args.id)
-    transport = TcpTransport(
-        node_id=args.id,
-        listen_addr=dep.addr(args.id),
-        peers=dep.peers_for(args.id),
-    )
+    transport = make_transport(args.transport, args.id, dep)
     await transport.start()
     replica = Replica(
         node_id=args.id,
@@ -82,6 +94,12 @@ def main() -> None:
         default="cpu",
         choices=["cpu", "cpu-pure", "tpu", "insecure"],
         help="signature verification backend",
+    )
+    ap.add_argument(
+        "--transport",
+        default="tcp",
+        choices=["tcp", "grpc"],
+        help="wire transport (grpc = HTTP/2 streams, the DCN path)",
     )
     ap.add_argument("--log-level", default="INFO")
     ap.add_argument(

@@ -81,12 +81,7 @@ def const(v: int) -> jnp.ndarray:
 
 ZERO = _int_to_limbs_np(0)
 ONE = _int_to_limbs_np(1)
-# p and 2p as limb constants (2p limbs used to keep subtraction nonnegative)
 P_LIMBS = _int_to_limbs_np(P_INT)
-TWO_P = np.concatenate([[2 * (2**RADIX - 19)], np.full(NLIMB - 1, 2 * MASK)]).astype(
-    np.int32
-)
-assert _limbs_to_int_np(TWO_P.reshape(NLIMB)) == 2 * P_INT
 
 
 def zeros_like(x: jnp.ndarray) -> jnp.ndarray:

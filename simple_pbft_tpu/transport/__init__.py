@@ -13,6 +13,9 @@ Broadcast (node.go:107-129). Redesigned:
 - ``tcp.TcpTransport`` — length-prefixed JSON over asyncio TCP with
   persistent reconnecting connections and bounded outboxes, for real
   multi-process committees (see node.py / launch.py).
+- ``grpc.GrpcTransport`` — persistent client-streaming RPCs over HTTP/2
+  (the DCN path, SURVEY.md §2.3); gRPC owns reconnects and flow control.
+  Imported lazily (``--transport grpc``) so grpcio stays optional.
 """
 
 from .base import Transport  # noqa: F401

@@ -34,3 +34,9 @@ jax.config.update("jax_platforms", "cpu")
 import simple_pbft_tpu  # noqa: E402
 
 simple_pbft_tpu.enable_jit_cache()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running scenario (large committees, storms)"
+    )

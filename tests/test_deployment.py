@@ -227,7 +227,7 @@ class TestLaunchIntegration:
             text=True,
             timeout=240,
             env=env,
-            cwd="/root/repo",
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         )
         assert out.returncode == 0, (out.stdout[-800:], out.stderr[-800:])
         assert '"ops": 8' in out.stdout, out.stdout[-800:]

@@ -48,6 +48,14 @@ class TestFieldOps:
         for v in BOUNDARY + rand_elems(20):
             assert unlimbs(limbs(v)) == v % P
 
+    def test_two_p_constant_encodes_2p(self):
+        # _two_p builds 2p from scalars (Pallas kernels must not capture
+        # array constants); pin it against the exact integer
+        import numpy as np
+
+        tp = np.asarray(fe._two_p(jnp.zeros((fe.NLIMB, 1), jnp.int32)))
+        assert fe._limbs_to_int_np(tp) == 2 * fe.P_INT
+
     def test_add_sub_mul(self):
         vals = BOUNDARY + rand_elems(30)
         b_vals = list(reversed(vals))

@@ -1,0 +1,171 @@
+"""gRPC transport: the DCN path (SURVEY.md §2.3, VERDICT missing #5).
+
+Mirrors the TCP transport's contract tests (tests/test_deployment.py):
+interchangeable behavior is the whole point — the replica runtime must
+not be able to tell the deployments apart. Plus one real 4-process
+launch over localhost gRPC.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from simple_pbft_tpu.transport.grpc import GrpcTransport  # noqa: E402
+from simple_pbft_tpu.transport.tcp import MAX_FRAME, OUTBOX_DEPTH  # noqa: E402
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+async def _pair():
+    """Two connected endpoints on ephemeral localhost ports."""
+    a = GrpcTransport("a", ("127.0.0.1", 0), peers={})
+    b = GrpcTransport("b", ("127.0.0.1", 0), peers={})
+    await a.start()
+    await b.start()
+    a.peers["b"] = ("127.0.0.1", b.bound_port)
+    b.peers["a"] = ("127.0.0.1", a.bound_port)
+    return a, b
+
+
+async def _stop_all(*ts):
+    for t in ts:
+        await t.stop()
+
+
+class TestGrpcTransport:
+    def test_roundtrip_and_self_send(self):
+        async def scenario():
+            a, b = await _pair()
+            try:
+                payloads = [b"x", b"y" * 1000, b"z" * 100_000]
+                for p in payloads:
+                    await a.send("b", p)
+                got = [await asyncio.wait_for(b.recv(), 20) for _ in payloads]
+                assert got == payloads
+                # streams are per-direction: b can answer over its own
+                await b.send("a", b"reply")
+                assert await asyncio.wait_for(a.recv(), 20) == b"reply"
+                # self-send loops back without touching the network
+                await a.send("a", b"self")
+                assert a.recv_nowait() == b"self"
+                # unknown destination: fire-and-forget no-op
+                await a.send("nobody", b"lost")
+            finally:
+                await _stop_all(a, b)
+
+        run(scenario())
+
+    def test_oversized_frame_dropped_at_send(self):
+        async def scenario():
+            a, b = await _pair()
+            try:
+                await a.send("b", b"x" * (MAX_FRAME + 1))
+                assert a.metrics["dropped_outbox"] == 1
+                # transport stays usable
+                await a.send("b", b"fits")
+                assert await asyncio.wait_for(b.recv(), 20) == b"fits"
+            finally:
+                await _stop_all(a, b)
+
+        run(scenario())
+
+    def test_reconnect_after_peer_restart(self):
+        async def scenario():
+            a, b = await _pair()
+            b_port = b.bound_port
+            try:
+                await a.send("b", b"one")
+                assert await asyncio.wait_for(b.recv(), 20) == b"one"
+                # peer goes down; frames sent meanwhile are fire-and-forget
+                await b.stop()
+                await a.send("b", b"into the void")
+                await asyncio.sleep(0.2)
+                # peer comes back on the SAME port; gRPC reconnects the
+                # channel and the sender loop reopens the stream
+                b2 = GrpcTransport("b", ("127.0.0.1", b_port), peers={})
+                await b2.start()
+                for attempt in range(100):
+                    await a.send("b", b"hello again %d" % attempt)
+                    got = b2.recv_nowait()
+                    if got is not None:
+                        break
+                    await asyncio.sleep(0.1)
+                else:
+                    raise AssertionError(
+                        f"no frame after restart (reconnects="
+                        f"{a.metrics['reconnects']})"
+                    )
+                await b2.stop()
+            finally:
+                await a.stop()
+
+        run(scenario())
+
+    def test_outbox_overflow_drops_not_blocks(self):
+        async def scenario():
+            # a peer that is never up: wait_for_ready parks the stream, the
+            # outbox fills, further sends drop without blocking the loop
+            a = GrpcTransport(
+                "a", ("127.0.0.1", 0), peers={"ghost": ("127.0.0.1", 1)}
+            )
+            await a.start()
+            try:
+                for i in range(OUTBOX_DEPTH + 100):
+                    await a.send("ghost", b"frame %d" % i)
+                assert a.metrics["dropped_outbox"] >= 90
+            finally:
+                await a.stop()
+
+        run(scenario())
+
+    def test_recv_queue_bound_drops(self):
+        async def scenario():
+            a = GrpcTransport("a", ("127.0.0.1", 0), peers={})
+            b = GrpcTransport("b", ("127.0.0.1", 0), peers={}, recv_depth=2)
+            await a.start()
+            await b.start()
+            a.peers["b"] = ("127.0.0.1", b.bound_port)
+            try:
+                for i in range(10):
+                    await a.send("b", b"m%d" % i)
+                for _ in range(200):
+                    if b.metrics["recv"] + b.metrics["dropped_recv"] >= 10:
+                        break
+                    await asyncio.sleep(0.05)
+                assert b.metrics["dropped_recv"] >= 8, dict(b.metrics)
+            finally:
+                await _stop_all(a, b)
+
+        run(scenario())
+
+
+class TestGrpcLaunchIntegration:
+    def test_four_node_launch_commits_load_over_grpc(self, tmp_path):
+        """4 replica processes + 1 client over localhost gRPC streams."""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"  # children must never touch the chip
+        base_port = 8400 + (os.getpid() % 500)  # dodge stale-orphan ports
+        out = subprocess.run(
+            [
+                sys.executable, "-m", "simple_pbft_tpu.launch",
+                "-n", "4", "--load", "8",
+                "--transport", "grpc",
+                "--base-port", str(base_port),
+                "--deploy-dir", str(tmp_path),
+                "--keep",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=240,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert out.returncode == 0, (out.stdout[-800:], out.stderr[-800:])
+        assert '"ops": 8' in out.stdout, out.stdout[-800:]
