@@ -164,14 +164,17 @@ def base_table_device() -> jnp.ndarray:
     return _BASE_TABLE_DEV
 
 
-def nibbles_np(le_bytes: np.ndarray) -> np.ndarray:
-    """(n, 32) uint8 little-endian scalar -> (n, 64) int32 nibbles, least
-    significant first (position i carries weight 16^i — matching
-    comb_table_np, order-free since the comb has no doublings). Callers
-    transpose to the device's (NPOS, B) position-major layout."""
-    lo = le_bytes & 0x0F
-    hi = le_bytes >> 4
-    return np.stack([lo, hi], axis=-1).reshape(le_bytes.shape[0], 64).astype(np.int32)
+def nibbles_major_np(le_bytes: np.ndarray) -> np.ndarray:
+    """(n, 32) uint8 little-endian scalar -> (NPOS, n) int32 nibbles,
+    least significant first (position i carries weight 16^i — matching
+    comb_table_np, order-free since the comb has no doublings).
+    POSITION-MAJOR — the device layout, written directly (interleaved row
+    assignment) so the hot prep path never transposes."""
+    cols = le_bytes.T  # (32, n) strided view
+    out = np.empty((NPOS, le_bytes.shape[0]), dtype=np.int32)
+    out[0::2] = cols & 0x0F
+    out[1::2] = cols >> 4
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -295,7 +298,7 @@ def fused_accumulate(
     pos = jnp.arange(NPOS, dtype=jnp.int32)[:, None]
     idx = row_base[None, :] + pos * FWINDOW + s_nibbles * WINDOW + k_nibbles
     rows_all = _gather_rows(f_flat, idx)  # (NPOS, ROW, B)
-    if ACCUM_IMPL == "pallas":
+    if _resolve_accum_impl() == "pallas":
         return _madd_loop_pallas(rows_all)
     acc0 = _ident_like(s_nibbles[0])
 
@@ -316,16 +319,28 @@ def fused_accumulate(
 # 64 x 256-byte rows it can't avoid.
 # ---------------------------------------------------------------------------
 
-ACCUM_IMPL = "xla"
+ACCUM_IMPL = "auto"
 PALLAS_TILE = 256  # batch lanes per kernel program (rows block = 4 MiB)
 
 
 def use_accum_impl(name: str) -> None:
-    """Select the fused-accumulate implementation ('xla' or 'pallas')
-    BEFORE any kernel is jitted — jit traces capture the choice."""
+    """Select the fused-accumulate implementation ('auto', 'xla' or
+    'pallas') BEFORE any kernel is jitted — jit traces capture the
+    choice. 'auto' resolves at trace time: the Pallas kernel on real TPU
+    (measured ~28% faster at batch 8k: 662k vs 516k verifies/s on a v5e),
+    the XLA fori_loop elsewhere (interpret-mode Pallas is far too slow
+    for CPU tests)."""
     global ACCUM_IMPL
-    assert name in ("xla", "pallas"), name
+    assert name in ("auto", "xla", "pallas"), name
     ACCUM_IMPL = name
+
+
+def _resolve_accum_impl() -> str:
+    if ACCUM_IMPL != "auto":
+        return ACCUM_IMPL
+    import jax
+
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 
 def _madd_loop_kernel(rows_ref, out_ref):

@@ -356,13 +356,31 @@ def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def bytes32_to_limbs_major_np(data: np.ndarray) -> np.ndarray:
+    """(n, 32) uint8 little-endian -> (17, n) int32 limbs of the low 255
+    bits (bit 255 — the sign bit — is excluded), LIMB-MAJOR — the device
+    layout, produced directly so the hot prep path never transposes.
+
+    Each 15-bit limb is a window of the 256-bit value: view the bytes as
+    four little-endian uint64 words and extract window i at bit 15*i with
+    two shifts — 17 vectorized ops total vs an unpackbits expansion to
+    256 int32 lanes per item (~10x faster at batch 8k)."""
+    words = np.ascontiguousarray(data).view("<u8")  # (n, 4)
+    out = np.empty((NLIMB, data.shape[0]), dtype=np.int32)
+    for i in range(NLIMB):
+        bitpos = i * RADIX
+        w, s = bitpos >> 6, bitpos & 63
+        v = words[:, w] >> np.uint64(s)
+        if s > 64 - RADIX and w + 1 < 4:  # window straddles a word boundary
+            v = v | (words[:, w + 1] << np.uint64(64 - s))
+        out[i] = (v & np.uint64(MASK)).astype(np.int32)
+    return out
+
+
 def bytes32_to_limbs_np(data: np.ndarray) -> np.ndarray:
-    """(n, 32) uint8 little-endian -> (n, 17) int32 limbs of the low 255
-    bits (bit 255 — the sign bit — is excluded)."""
-    bits = np.unpackbits(data, axis=-1, bitorder="little")  # (n, 256)
-    bits255 = bits[..., :255].reshape(*data.shape[:-1], NLIMB, RADIX)
-    weights = (1 << np.arange(RADIX, dtype=np.int32))
-    return (bits255.astype(np.int32) * weights).sum(axis=-1).astype(np.int32)
+    """(n, 32) uint8 little-endian -> (n, 17) int32 limbs (batch-major
+    form for host-side table building; see bytes32_to_limbs_major_np)."""
+    return bytes32_to_limbs_major_np(data).T
 
 
 def sign_bits_np(data: np.ndarray) -> np.ndarray:

@@ -40,13 +40,23 @@ _METHOD = f"/{_SERVICE}/Stream"
 # Raw-bytes (de)serializers: the wire body is already canonical JSON.
 _ident = lambda b: b  # noqa: E731
 
-_CHANNEL_OPTIONS = [
+_COMMON_OPTIONS = [
     ("grpc.max_send_message_length", MAX_FRAME),
     ("grpc.max_receive_message_length", MAX_FRAME),
     # Consensus traffic is latency-sensitive and self-retransmitting:
     # fail fast and keep the transport's own backoff in charge.
     ("grpc.enable_retries", 0),
+]
+_CHANNEL_OPTIONS = _COMMON_OPTIONS + [
     ("grpc.keepalive_time_ms", 10_000),
+    ("grpc.keepalive_permit_without_calls", 1),
+]
+_SERVER_OPTIONS = _COMMON_OPTIONS + [
+    # accept the clients' 10 s keepalives on idle streams: without these
+    # the server's default ping-strike policy (2 strikes, 5 min min
+    # interval) GOAWAYs every quiet connection ~30 s into an idle period
+    ("grpc.http2.min_recv_ping_interval_without_data_ms", 9_000),
+    ("grpc.http2.max_ping_strikes", 0),
     ("grpc.keepalive_permit_without_calls", 1),
 ]
 
@@ -86,7 +96,7 @@ class GrpcTransport:
     # -- lifecycle ------------------------------------------------------
 
     async def start(self) -> None:
-        server = grpc.aio.server(options=_CHANNEL_OPTIONS)
+        server = grpc.aio.server(options=_SERVER_OPTIONS)
         handler = grpc.method_handlers_generic_handler(
             _SERVICE,
             {

@@ -48,6 +48,35 @@ class TestFieldOps:
         for v in BOUNDARY + rand_elems(20):
             assert unlimbs(limbs(v)) == v % P
 
+    def test_bytes32_to_limbs_window_extraction(self):
+        # the uint64-window fast path must agree with direct bit math on
+        # the low 255 bits (bit 255, the sign bit, excluded)
+        import numpy as np
+
+        rng = np.random.default_rng(7)
+        data = rng.integers(0, 256, (50, 32), dtype=np.uint8)
+        data[0, :] = 0xFF  # all-ones boundary
+        data[1, :] = 0
+        out = fe.bytes32_to_limbs_major_np(data)
+        assert out.shape == (fe.NLIMB, 50)
+        for j in range(50):
+            v = int.from_bytes(bytes(data[j]), "little") & ((1 << 255) - 1)
+            assert fe._limbs_to_int_np(out[:, j : j + 1]) == v
+
+    def test_nibbles_major_layout(self):
+        import numpy as np
+
+        from simple_pbft_tpu.ops import comb
+
+        rng = np.random.default_rng(8)
+        data = rng.integers(0, 256, (20, 32), dtype=np.uint8)
+        out = comb.nibbles_major_np(data)
+        assert out.shape == (comb.NPOS, 20)
+        for j in range(20):
+            v = int.from_bytes(bytes(data[j]), "little")
+            got = sum(int(out[i, j]) << (4 * i) for i in range(comb.NPOS))
+            assert got == v
+
     def test_two_p_constant_encodes_2p(self):
         # _two_p builds 2p from scalars (Pallas kernels must not capture
         # array constants); pin it against the exact integer
