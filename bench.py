@@ -133,6 +133,8 @@ def main() -> None:
 
     mode = os.environ.get("BENCH_MODE", "fused")
     assert mode in ("fused", "comb"), mode
+    # comb mode is fixed at 4-bit windows; report what actually runs
+    wbits = int(os.environ.get("BENCH_WINDOW", "4")) if mode == "fused" else 4
     platform = jax.devices()[0].platform
     top_batch = int(os.environ.get("BENCH_BATCH", str(BUCKETS[-1])))
     # comb kernel's batch inversion needs a power-of-two batch
@@ -148,7 +150,7 @@ def main() -> None:
         msg = b"bench vote %d" % i
         items.append(BatchItem(ref.public_key(seed), msg, ref.sign(seed, msg)))
 
-    bank = KeyBank(mode=mode)
+    bank = KeyBank(mode=mode, window=wbits)
     _best["note"] = f"building {mode} key tables ({n_signers} keys)"
     t0 = time.perf_counter()
     for it in items:
@@ -173,7 +175,8 @@ def main() -> None:
 
         def fn(s_nib, k_nib, a_idx, r_y, r_sign, precheck):
             return comb.fused_verify_kernel(
-                s_nib, k_nib, a_idx, tables, r_y, r_sign, precheck
+                s_nib, k_nib, a_idx, tables, r_y, r_sign, precheck,
+                window=1 << wbits,
             )
 
     fn = jax.jit(fn)
@@ -264,8 +267,9 @@ def main() -> None:
         table_build_s=round(table_build_s, 1),
         platform=platform,
         mode=mode,
+        window=wbits,
         mul=mul_impl,
-        accum=accum_impl,
+        accum=comb._resolve_accum_impl(),  # what actually ran, not "auto"
     )
 
 

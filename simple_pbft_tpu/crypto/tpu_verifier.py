@@ -31,6 +31,7 @@ double-scalar multiplication and an equality — no second ladder.
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Dict, List, Optional, Sequence
 
@@ -265,17 +266,24 @@ class KeyBank:
         initial_capacity: int = 8,
         max_keys: Optional[int] = None,
         mode: str = "comb",
+        window: int = 4,
     ):
         assert mode in ("comb", "fused")
+        assert window in (4, 5, 6), window
         self._mode = mode
+        self.window = window
         if mode == "comb":
+            assert window == 4, "comb mode is fixed at 4-bit windows"
             self._builder = comb.comb_table_np
             self._rows_per_key = comb.NPOS * comb.WINDOW
             default_max = 1024  # ~260 KB/key
         else:
-            self._builder = comb.fused_table_np
-            self._rows_per_key = comb.NPOS * comb.FWINDOW
-            default_max = 256  # ~4.2 MB/key: cap device memory at ~1 GB
+            self._builder = lambda pt: comb.fused_table_np(pt, window)
+            self._rows_per_key = comb.npos_for(window) * (1 << (2 * window))
+            # cap device table memory at ~1 GB whatever the window
+            # (w=4: ~4.2 MB/key -> 256 keys; w=5: ~13.6 MB -> 78;
+            # w=6: ~45 MB -> 23); over-cap keys fall back to the CPU path
+            default_max = max(8, (1 << 30) // (self._rows_per_key * comb.ROW * 4))
         self._index: Dict[bytes, int] = {}
         self._invalid_cache: set = set()
         self._max_keys = default_max if max_keys is None else max_keys
@@ -387,10 +395,11 @@ def prepare_comb_batch(
     ok &= ~_ge_l_np(s_raw)
     ok &= ~_ge_p_np(r_raw)
 
+    wbits = getattr(bank, "window", 4)
     batch = CombBatch(
         n,
-        comb.nibbles_major_np(s_raw),
-        comb.nibbles_major_np(k_raw),
+        comb.windows_major_np(s_raw, wbits),
+        comb.windows_major_np(k_raw, wbits),
         a_idx,
         fe.bytes32_to_limbs_major_np(r_raw),
         fe.sign_bits_np(r_raw),
@@ -420,13 +429,15 @@ def _shared_jit(mode: str):
     practical deadlock on single-core CI hosts)."""
     fn = _JIT_CACHE.get(mode)
     if fn is None:
-        fn = jax.jit(
-            {
+        if mode.startswith("fused"):
+            window = 1 << int(mode[5:] or "4")  # "fused" / "fused5" / "fused6"
+            kernel = functools.partial(comb.fused_verify_kernel, window=window)
+        else:
+            kernel = {
                 "comb": comb.comb_verify_kernel,
-                "fused": comb.fused_verify_kernel,
                 "ladder": verify_kernel,
             }[mode]
-        )
+        fn = jax.jit(kernel)
         _JIT_CACHE[mode] = fn
     return fn
 
@@ -450,12 +461,21 @@ class TpuVerifier:
     name = "tpu"
 
     def __init__(
-        self, mesh: Optional[jax.sharding.Mesh] = None, mode: str = "fused"
+        self,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        mode: str = "fused",
+        window: int = 4,
     ):
         assert mode in ("comb", "fused", "ladder")
+        assert window == 4 or mode == "fused", "window is a fused-mode knob"
         self._mesh = mesh
         self._mode = mode
-        self._bank = KeyBank(mode=mode) if mode in ("comb", "fused") else None
+        self._window = window
+        self._bank = (
+            KeyBank(mode=mode, window=window)
+            if mode in ("comb", "fused")
+            else None
+        )
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -470,8 +490,15 @@ class TpuVerifier:
                     out_shardings=vec,
                 )
             elif mode == "fused":
+                # accum="xla": the Pallas custom call has no GSPMD
+                # partitioning rule; inside this sharded jit the XLA
+                # fori_loop is the implementation that partitions
                 self._fn = jax.jit(
-                    comb.fused_verify_kernel,
+                    functools.partial(
+                        comb.fused_verify_kernel,
+                        window=1 << window,
+                        accum="xla",
+                    ),
                     in_shardings=(mat, mat, vec, repl, mat, vec, vec),
                     out_shardings=vec,
                 )
@@ -492,7 +519,8 @@ class TpuVerifier:
                     f"{self._align} devices"
                 )
         else:
-            self._fn = _shared_jit(mode)
+            key = mode if window == 4 else f"fused{window}"
+            self._fn = _shared_jit(key)
             self._align = 1
 
     def verify_batch(self, items: Sequence[BatchItem]) -> List[bool]:

@@ -54,6 +54,11 @@ WINDOW = 16
 FWINDOW = WINDOW * WINDOW  # fused (s_nibble, k_nibble) window: 256 entries
 ROW = 64  # packed Niels row: 3*17 int32 limbs + 13 pad to a 256B row
 
+
+def npos_for(wbits: int) -> int:
+    """Positions covering a 256-bit scalar with wbits-bit windows."""
+    return -(-256 // wbits)
+
 # ---------------------------------------------------------------------------
 # Host-side table construction (exact Python bigints -> packed limb rows)
 # ---------------------------------------------------------------------------
@@ -115,29 +120,31 @@ def _point_neg(p: ref.Point) -> ref.Point:
     return ((-x) % ref.P, y, z, (-t) % ref.P)
 
 
-def fused_table_np(point: ref.Point) -> np.ndarray:
-    """(NPOS * FWINDOW, ROW) packed rows:
-    row[i*FW + ws*16 + wk] = (ws * 16^i) B + (wk * 16^i) (−A).
+def fused_table_np(point: ref.Point, wbits: int = 4) -> np.ndarray:
+    """(npos * 4^wbits, ROW) packed rows for wbits-bit windows:
+    row[i*FW + ws*2^w + wk] = (ws * 2^(w*i)) B + (wk * 2^(w*i)) (−A),
+    FW = 4^wbits, npos = ceil(256/wbits).
 
-    One row fetch + ONE mixed add per nibble position evaluates
-    [S]B + [k](−A) — half the madds of the separate-table comb (the
-    device cost per signature drops from 128 to 64 mixed adds). The
-    16x-larger table trades HBM capacity (~4.2 MB/key packed) for
-    compute; keys are few (a committee) and endlessly reused, so the
-    build amortizes.
+    One row fetch + ONE mixed add per window position evaluates
+    [S]B + [k](−A) — half the madds of the separate-table comb. Wider
+    windows cut positions (and device madds) at the cost of a bigger
+    per-key table: w=4 -> 64 positions / ~4.2 MB per key, w=5 -> 52 /
+    ~13.6 MB, w=6 -> 43 / ~45 MB. Keys are few (a committee) and
+    endlessly reused, so the build amortizes; KeyBank caps total memory.
     """
+    window = 1 << wbits
     pts = []
     base_b = ref.B
     base_a = _point_neg(point)
-    for i in range(NPOS):
+    for i in range(npos_for(wbits)):
         row_b = ref.IDENTITY
-        for ws in range(WINDOW):
+        for ws in range(window):
             acc = row_b
-            for wk in range(WINDOW):
+            for wk in range(window):
                 pts.append(acc)
                 acc = ref.point_add(acc, base_a)
             row_b = ref.point_add(row_b, base_b)
-        for _ in range(4):  # bases <- 16 * bases
+        for _ in range(wbits):  # bases <- 2^wbits * bases
             base_b = ref.point_double(base_b)
             base_a = ref.point_double(base_a)
     return _batch_affine_niels_np(pts)
@@ -175,6 +182,17 @@ def nibbles_major_np(le_bytes: np.ndarray) -> np.ndarray:
     out[0::2] = cols & 0x0F
     out[1::2] = cols >> 4
     return out
+
+
+def windows_major_np(le_bytes: np.ndarray, wbits: int) -> np.ndarray:
+    """(n, 32) uint8 little-endian scalar -> (npos, n) int32 wbits-bit
+    windows, least significant first, position-major (the shared
+    fe.extract_windows_np decoder; w=4 keeps the cheaper nibble
+    interleave). The top position's window is naturally truncated to the
+    scalar's top bits."""
+    if wbits == 4:
+        return nibbles_major_np(le_bytes)
+    return fe.extract_windows_np(le_bytes, wbits, npos_for(wbits))
 
 
 # ---------------------------------------------------------------------------
@@ -280,32 +298,38 @@ def comb_accumulate(
 
 
 def fused_accumulate(
-    s_nibbles: jnp.ndarray,
-    k_nibbles: jnp.ndarray,
+    s_windows: jnp.ndarray,
+    k_windows: jnp.ndarray,
     row_base: jnp.ndarray,
     f_flat: jnp.ndarray,
+    window: int = WINDOW,
+    accum: Optional[str] = None,
 ) -> jnp.ndarray:
     """[S]B + [k](−A) via the fused dual-scalar table: one row fetch + one
-    mixed add per nibble position (64 total).
+    mixed add per window position (npos total; 64 for 4-bit windows).
 
-    s_nibbles, k_nibbles: (NPOS, B) int32. row_base: (B,) int32 =
-    key_index * NPOS * FWINDOW. f_flat: (n_keys*NPOS*FWINDOW, ROW).
+    s_windows, k_windows: (npos, B) int32. row_base: (B,) int32 =
+    key_index * npos * window^2. f_flat: (n_keys*npos*window^2, ROW).
+    `window` = 2^wbits is static (captured at trace time).
 
     The madd loop runs either as plain XLA (fori_loop) or as a Pallas
     kernel that keeps the accumulator and every field-mul intermediate in
-    VMEM across all 64 positions (`use_accum_impl`).
+    VMEM across all positions (`use_accum_impl`). `accum` overrides the
+    global choice — the GSPMD-sharded mesh path must force "xla" (a
+    Mosaic custom call has no partitioning rule inside a sharded jit).
     """
-    pos = jnp.arange(NPOS, dtype=jnp.int32)[:, None]
-    idx = row_base[None, :] + pos * FWINDOW + s_nibbles * WINDOW + k_nibbles
-    rows_all = _gather_rows(f_flat, idx)  # (NPOS, ROW, B)
-    if _resolve_accum_impl() == "pallas":
+    npos = s_windows.shape[0]
+    pos = jnp.arange(npos, dtype=jnp.int32)[:, None]
+    idx = row_base[None, :] + pos * (window * window) + s_windows * window + k_windows
+    rows_all = _gather_rows(f_flat, idx)  # (npos, ROW, B)
+    if (accum or _resolve_accum_impl()) == "pallas":
         return _madd_loop_pallas(rows_all)
-    acc0 = _ident_like(s_nibbles[0])
+    acc0 = _ident_like(s_windows[0])
 
     def body(i, acc):
         return madd(acc, rows_all[i])
 
-    return lax.fori_loop(0, NPOS, body, acc0)
+    return lax.fori_loop(0, npos, body, acc0)
 
 
 # ---------------------------------------------------------------------------
@@ -344,7 +368,7 @@ def _resolve_accum_impl() -> str:
 
 
 def _madd_loop_kernel(rows_ref, out_ref):
-    """Pallas body: rows_ref (NPOS, ROW, T) VMEM block -> out_ref
+    """Pallas body: rows_ref (npos, ROW, T) VMEM block -> out_ref
     (4*NLIMB, T) — the accumulated [S]B + [k](−A) in extended coords."""
     n = fe.NLIMB
     tile = out_ref.shape[-1]
@@ -357,7 +381,7 @@ def _madd_loop_kernel(rows_ref, out_ref):
     def body(i, acc):
         return _madd_tuple(*acc, rows_ref[i])
 
-    x, y, z, t = lax.fori_loop(0, NPOS, body, (zero, one, one, zero))
+    x, y, z, t = lax.fori_loop(0, rows_ref.shape[0], body, (zero, one, one, zero))
     out_ref[0 * n : 1 * n] = x
     out_ref[1 * n : 2 * n] = y
     out_ref[2 * n : 3 * n] = z
@@ -365,11 +389,11 @@ def _madd_loop_kernel(rows_ref, out_ref):
 
 
 def _madd_loop_pallas(rows_all: jnp.ndarray) -> jnp.ndarray:
-    """(NPOS, ROW, B) gathered rows -> (4, 17, B) accumulator."""
+    """(npos, ROW, B) gathered rows -> (4, 17, B) accumulator."""
     import jax
     from jax.experimental import pallas as pl
 
-    b = rows_all.shape[-1]
+    npos, b = rows_all.shape[0], rows_all.shape[-1]
     tile = min(PALLAS_TILE, b)
     assert b % tile == 0, (b, tile)
     out = pl.pallas_call(
@@ -377,7 +401,7 @@ def _madd_loop_pallas(rows_all: jnp.ndarray) -> jnp.ndarray:
         out_shape=jax.ShapeDtypeStruct((4 * fe.NLIMB, b), jnp.int32),
         grid=(b // tile,),
         in_specs=[
-            pl.BlockSpec((NPOS, ROW, tile), lambda i: (0, 0, i)),
+            pl.BlockSpec((npos, ROW, tile), lambda i: (0, 0, i)),
         ],
         out_specs=pl.BlockSpec((4 * fe.NLIMB, tile), lambda i: (0, i)),
         interpret=jax.default_backend() != "tpu",
@@ -425,16 +449,27 @@ def _encode_and_compare(
 
 
 def fused_verify_kernel(
-    s_nibbles: jnp.ndarray,  # (NPOS, B) int32 — S scalar nibbles
-    k_nibbles: jnp.ndarray,  # (NPOS, B) int32 — challenge scalar nibbles
+    s_windows: jnp.ndarray,  # (npos, B) int32 — S scalar windows
+    k_windows: jnp.ndarray,  # (npos, B) int32 — challenge scalar windows
     a_index: jnp.ndarray,  # (B,) int32 — key row into the fused table bank
-    f_table: jnp.ndarray,  # (n_keys*NPOS*FWINDOW, ROW) packed Niels rows
+    f_table: jnp.ndarray,  # (n_keys*npos*window^2, ROW) packed Niels rows
     r_y: jnp.ndarray,  # (17, B) int32 — R's canonical y limbs
     r_sign: jnp.ndarray,  # (B,) int32 — R's x sign bit
     precheck: jnp.ndarray,  # (B,) bool — host-side validity mask
+    window: int = WINDOW,  # static: 2^wbits entries per scalar per position
+    accum: Optional[str] = None,  # static accumulate-impl override
 ) -> jnp.ndarray:
-    """Batched verify via the fused comb: 64 row fetches + 64 madds/row."""
-    p = fused_accumulate(s_nibbles, k_nibbles, a_index * (NPOS * FWINDOW), f_table)
+    """Batched verify via the fused comb: one row fetch + one madd per
+    window position (64 at w=4, 52 at w=5, 43 at w=6)."""
+    npos = s_windows.shape[0]
+    p = fused_accumulate(
+        s_windows,
+        k_windows,
+        a_index * (npos * window * window),
+        f_table,
+        window=window,
+        accum=accum,
+    )
     return _encode_and_compare(p, r_y, r_sign, precheck)
 
 

@@ -356,25 +356,34 @@ def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def bytes32_to_limbs_major_np(data: np.ndarray) -> np.ndarray:
-    """(n, 32) uint8 little-endian -> (17, n) int32 limbs of the low 255
-    bits (bit 255 — the sign bit — is excluded), LIMB-MAJOR — the device
-    layout, produced directly so the hot prep path never transposes.
+def extract_windows_np(data: np.ndarray, wbits: int, count: int) -> np.ndarray:
+    """(n, 32) uint8 little-endian -> (count, n) int32: window i holds
+    bits [i*wbits, (i+1)*wbits) of the 256-bit value, position-major (the
+    device layout, produced directly so hot prep paths never transpose).
 
-    Each 15-bit limb is a window of the 256-bit value: view the bytes as
-    four little-endian uint64 words and extract window i at bit 15*i with
-    two shifts — 17 vectorized ops total vs an unpackbits expansion to
-    256 int32 lanes per item (~10x faster at batch 8k)."""
+    View the bytes as four little-endian uint64 words and extract each
+    window with two shifts — `count` vectorized ops total vs an
+    unpackbits expansion to 256 int32 lanes per item (~10x faster at
+    batch 8k). Windows extending past bit 255 are naturally truncated.
+    Shared by the field-limb (wbits=15) and comb-window (wbits=4/5/6)
+    decoders so the word-straddle logic lives in exactly one place."""
     words = np.ascontiguousarray(data).view("<u8")  # (n, 4)
-    out = np.empty((NLIMB, data.shape[0]), dtype=np.int32)
-    for i in range(NLIMB):
-        bitpos = i * RADIX
+    mask = np.uint64((1 << wbits) - 1)
+    out = np.empty((count, data.shape[0]), dtype=np.int32)
+    for i in range(count):
+        bitpos = i * wbits
         w, s = bitpos >> 6, bitpos & 63
         v = words[:, w] >> np.uint64(s)
-        if s > 64 - RADIX and w + 1 < 4:  # window straddles a word boundary
+        if s > 64 - wbits and w + 1 < 4:  # window straddles a word boundary
             v = v | (words[:, w + 1] << np.uint64(64 - s))
-        out[i] = (v & np.uint64(MASK)).astype(np.int32)
+        out[i] = (v & mask).astype(np.int32)
     return out
+
+
+def bytes32_to_limbs_major_np(data: np.ndarray) -> np.ndarray:
+    """(n, 32) uint8 little-endian -> (17, n) int32 limbs of the low 255
+    bits (bit 255 — the sign bit — is excluded), limb-major."""
+    return extract_windows_np(data, RADIX, NLIMB)
 
 
 def bytes32_to_limbs_np(data: np.ndarray) -> np.ndarray:

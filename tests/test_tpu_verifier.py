@@ -101,6 +101,34 @@ def test_empty_batch(verifier):
     assert verifier.verify_batch([]) == []
 
 
+def test_windows_major_extraction():
+    """wbits-bit window extraction must reassemble to the scalar for
+    every supported width (the w>4 comb geometries depend on it)."""
+    from simple_pbft_tpu.ops import comb
+
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, (16, 32), dtype=np.uint8)
+    data[0, :] = 0xFF
+    for w in (4, 5, 6):
+        out = comb.windows_major_np(data, w)
+        assert out.shape == (comb.npos_for(w), 16)
+        assert (out < (1 << w)).all() and (out >= 0).all()
+        for j in range(16):
+            v = sum(int(out[i, j]) << (w * i) for i in range(out.shape[0]))
+            assert v == int.from_bytes(bytes(data[j]), "little")
+
+
+def test_fused_window5_matches_oracle():
+    """The wide-window comb (fewer positions, bigger tables) must stay
+    bit-exact: w=5 TpuVerifier vs the RFC 8032 oracle on a mixed batch."""
+    v5 = TpuVerifier(mode="fused", window=5)
+    good = [_signed(i, b"w5 %d" % i) for i in range(3)]
+    tampered = BatchItem(good[0].pubkey, b"tampered", good[0].sig)
+    items = good + [tampered]
+    oracle = [ref.verify(i.pubkey, i.msg, i.sig) for i in items]
+    assert v5.verify_batch(items) == oracle == [True, True, True, False]
+
+
 def test_keybank_cap_falls_back_to_cpu():
     """Keys beyond the bank cap must still verify correctly (CPU path),
     and the bank must not grow past max_keys."""
@@ -213,6 +241,6 @@ def test_pallas_accumulate_matches_xla():
         comb.use_accum_impl("pallas")
         got = np.asarray(comb.fused_verify_kernel(*args))
     finally:
-        comb.use_accum_impl("xla")
+        comb.use_accum_impl("auto")  # restore the shipped default
     assert want.tolist() == [True] * 5 + [False] + [True] * 2
     assert got.tolist() == want.tolist()
