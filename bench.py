@@ -235,6 +235,23 @@ def main() -> None:
         )
     _best["note"] = best_note
 
+    # Optional profiler capture (SURVEY.md §5: "JAX profiler traces for
+    # the verify kernel"): BENCH_PROFILE=<dir> records a trace of a few
+    # steady-state passes at the best batch, viewable in TensorBoard /
+    # Perfetto. Guarded: profiling over the remote-device tunnel can be
+    # unsupported, and a failed capture must not cost the bench run.
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir and _best["batch"]:
+        try:
+            arrays = staged(_best["batch"])
+            with jax.profiler.trace(profile_dir):
+                for _ in range(3):
+                    out = fn(*arrays)
+                out.block_until_ready()
+            print(f"profiler trace written to {profile_dir}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"profiler capture failed: {e!r}", file=sys.stderr)
+
     # End-to-end: the full verify path per batch — host prep (wire bytes ->
     # arrays, native SHA-512 challenges), host->device transfer, kernel
     # dispatch. Dispatches are async, so the device verifies batch k while
@@ -269,7 +286,8 @@ def main() -> None:
         mode=mode,
         window=wbits,
         mul=mul_impl,
-        accum=comb._resolve_accum_impl(),  # what actually ran, not "auto"
+        # what actually ran, not "auto"; comb mode has no Pallas path
+        accum=comb._resolve_accum_impl() if mode == "fused" else "xla",
     )
 
 

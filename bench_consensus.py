@@ -186,6 +186,7 @@ async def run_config(
     rec = {
         "config": name,
         "n": n,
+        "qc_mode": qc_mode,
         "verifier": verifier,
         "clients": n_clients,
         "outstanding": per_client * n_clients,
@@ -234,6 +235,10 @@ async def main() -> None:
         "3": dict(name="pbft-n64", n=64),
         "4": dict(name="bls-qc-n256", n=256, qc_mode=True),
         "100": dict(name="pbft-n100", n=100),
+        # qc_mode at mid sizes: the storm comparison points — a NEW-VIEW
+        # carries 2f+1 O(1) QCs instead of 2f+1 full vote certificates
+        "qc16": dict(name="bls-qc-n16", n=16, qc_mode=True),
+        "qc64": dict(name="bls-qc-n64", n=64, qc_mode=True),
     }
     for key in args.configs.split(","):
         key = key.strip()
@@ -246,7 +251,7 @@ async def main() -> None:
         if args.storm:
             cfg = ladder[key]
             rec = await run_config(
-                f"viewchange-storm-n{cfg['n']}", cfg["n"], args.seconds,
+                f"viewchange-storm-{cfg['name']}", cfg["n"], args.seconds,
                 args.clients, args.outstanding, args.verifier, args.batch,
                 storm=True, view_timeout=args.view_timeout,
                 qc_mode=cfg.get("qc_mode", False),
