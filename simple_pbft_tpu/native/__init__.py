@@ -27,24 +27,31 @@ log = logging.getLogger(__name__)
 
 _SRC = os.path.join(os.path.dirname(__file__), "pbft_native.cpp")
 _SO = os.path.join(os.path.dirname(__file__), "_pbft_native.so")
+_SRC_BLS = os.path.join(os.path.dirname(__file__), "bls381.cpp")
+_SO_BLS = os.path.join(os.path.dirname(__file__), "_bls381.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+# own lock: a first-use BLS build (g++, up to ~2 min) must not stall
+# Ed25519 host-prep calls on the unrelated library
+_bls_lock = threading.Lock()
+_bls_lib: Optional[ctypes.CDLL] = None
+_bls_tried = False
 
 _u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
 _i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
 
 
-def _build() -> bool:
+def _build_so(src: str, so: str, extra=()) -> bool:
     # per-process temp name: concurrent builders (multi-process launch,
     # parallel test workers) must never interleave linker output in a
     # shared file; os.replace keeps the final install atomic
-    tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-fopenmp", "-shared", "-fPIC", "-o", tmp, _SRC]
+    tmp = f"{so}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", *extra, "-shared", "-fPIC", "-o", tmp, src]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
+        os.replace(tmp, so)
         return True
     except (OSError, subprocess.SubprocessError) as e:
         detail = getattr(e, "stderr", b"") or b""
@@ -55,6 +62,10 @@ def _build() -> bool:
         except OSError:
             pass
         return False
+
+
+def _build() -> bool:
+    return _build_so(_SRC, _SO, extra=("-fopenmp",))
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -85,6 +96,86 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.native_num_threads.restype = ctypes.c_int
         _lib = lib
         return _lib
+
+
+def _load_bls() -> Optional[ctypes.CDLL]:
+    """Loader for the BLS12-381 pairing library (bls381.cpp) — same
+    build-on-demand + Python-fallback contract as the host-prep lib."""
+    global _bls_lib, _bls_tried
+    with _bls_lock:
+        if _bls_tried:
+            return _bls_lib
+        _bls_tried = True
+        try:
+            fresh = os.path.exists(_SO_BLS) and (
+                os.path.getmtime(_SO_BLS) >= os.path.getmtime(_SRC_BLS)
+            )
+        except OSError:  # source missing: use the existing .so as-is
+            fresh = os.path.exists(_SO_BLS)
+        if not fresh and not _build_so(_SRC_BLS, _SO_BLS):
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_BLS)
+        except OSError as e:
+            log.warning("bls381 load failed: %s — using Python fallback", e)
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64 = ctypes.c_int64
+        lib.bls_verify_one.argtypes = [
+            u8p, u8p, i64, u8p, u8p, i64, ctypes.c_int,
+        ]
+        lib.bls_verify_one.restype = ctypes.c_int
+        lib.bls_verify_aggregate.argtypes = [u8p, i64, u8p, i64, u8p, u8p, i64]
+        lib.bls_verify_aggregate.restype = ctypes.c_int
+        lib.bls_selftest.argtypes = []
+        lib.bls_selftest.restype = ctypes.c_int
+        if lib.bls_selftest() != 1:
+            log.warning("bls381 selftest FAILED — using Python fallback")
+            return None
+        _bls_lib = lib
+        return _bls_lib
+
+
+def _cbuf(b: bytes):
+    return (ctypes.c_uint8 * max(1, len(b))).from_buffer_copy(b or b"\0")
+
+
+def bls_available() -> bool:
+    return _load_bls() is not None
+
+
+def bls_verify_one(
+    pubkey: bytes, msg: bytes, sig: bytes, dst: bytes, check_pk: bool
+) -> Optional[bool]:
+    """Native single-signature BLS verify; None = library unavailable
+    (caller falls back to the Python path)."""
+    if len(pubkey) != 192 or len(sig) != 96:
+        return False
+    lib = _load_bls()
+    if lib is None:
+        return None
+    r = lib.bls_verify_one(
+        _cbuf(pubkey), _cbuf(msg), len(msg), _cbuf(sig), _cbuf(dst),
+        len(dst), 1 if check_pk else 0,
+    )
+    return bool(r)
+
+
+def bls_verify_aggregate(
+    pubkeys: Sequence[bytes], msg: bytes, sig: bytes, dst: bytes
+) -> Optional[bool]:
+    """Native aggregate BLS verify; None = library unavailable."""
+    if not pubkeys or len(sig) != 96 or any(len(p) != 192 for p in pubkeys):
+        return False
+    lib = _load_bls()
+    if lib is None:
+        return None
+    cat = b"".join(pubkeys)
+    r = lib.bls_verify_aggregate(
+        _cbuf(cat), len(pubkeys), _cbuf(msg), len(msg), _cbuf(sig),
+        _cbuf(dst), len(dst),
+    )
+    return bool(r)
 
 
 def available() -> bool:

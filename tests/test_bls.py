@@ -136,6 +136,70 @@ def test_aggregate_and_pop(keys):
     assert not bls.pop_verify(keys[1][1], pop)
 
 
+def test_native_and_python_paths_agree(keys, monkeypatch):
+    """Differential check: the C++ pairing library (native/bls381.cpp)
+    and this module's bigint path must return identical verdicts on
+    valid, forged, corrupted, and malformed inputs."""
+    from simple_pbft_tpu import native
+
+    if not native.bls_available():
+        pytest.skip("no native toolchain")
+    msg = b"differential payload"
+    sigs = [bls.sign(sk, msg) for sk, _ in keys]
+    pks = [pk for _, pk in keys]
+    agg = bls.aggregate_signatures(sigs)
+    corrupt = bytearray(agg)
+    corrupt[7] ^= 2
+    sk0, pk0 = keys[0]
+    pop = bls.pop_prove(sk0, pk0)
+    s0 = bls.sign(sk0, msg)
+    # on-curve but OUT of the r-subgroup (no cofactor clearing): the one
+    # input class where the two subgroup-check implementations differ
+    # structurally (ZeroDivisionError catch vs mid-ladder fail flag)
+    x = 0
+    while True:
+        x += 1
+        y2 = (x * x * x + 4) % bls.P
+        y = pow(y2, (bls.P + 1) // 4, bls.P)
+        if y * y % bls.P == y2:
+            nonsub = (x, y)
+            if not bls._subgroup_check_g1(nonsub):
+                break
+    nonsub_sig = bls._g1_to_bytes(nonsub)
+
+    def run_all():
+        return [
+            bls.verify_aggregate(pks, msg, nonsub_sig),
+            bls.verify(pk0, msg, nonsub_sig),
+            bls.verify_aggregate(pks, msg, agg),
+            bls.verify_aggregate(pks[:2], msg, agg),
+            bls.verify_aggregate(pks, b"forged", agg),
+            bls.verify_aggregate(pks, msg, bytes(corrupt)),
+            bls.verify_aggregate(pks, msg, b"\x00" * bls.G1_BYTES),
+            bls.verify(pk0, msg, s0),
+            bls.verify(keys[1][1], msg, s0),
+            bls.pop_verify(pk0, pop),
+            bls.pop_verify(keys[1][1], pop),
+        ]
+
+    native_results = run_all()
+
+    class _NoNative:
+        @staticmethod
+        def bls_verify_one(*a, **k):
+            return None
+
+        @staticmethod
+        def bls_verify_aggregate(*a, **k):
+            return None
+
+    monkeypatch.setattr(bls, "_native", lambda: _NoNative)
+    python_results = run_all()
+    assert native_results == python_results
+    assert native_results[0] is False and native_results[1] is False
+    assert native_results[2] is True and native_results[7] is True
+
+
 # ---------------------------------------------------------------------------
 # QC helpers
 # ---------------------------------------------------------------------------
