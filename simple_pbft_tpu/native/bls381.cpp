@@ -866,113 +866,224 @@ static Pt<Fp> hash_to_g1(const uint8_t* msg, size_t msg_len,
 // Miller loop + final exponentiation (mirrors bls.py's untwisted form)
 // ---------------------------------------------------------------------------
 
-// untwist: E'(Fp2) -> E(Fp12)
-static void untwist(Pt<F12>& r, const Pt<F2>& q) {
-  // xi_inv
-  F2 xi = {FP_ONE, FP_ONE};  // 1 + u (both coords 1 in Mont form)
-  F2 xi_inv;
-  f2_inv(xi_inv, xi);
-  F2 xs, ys;
-  f2_mul(xs, q.x, xi_inv);
-  f2_mul(ys, q.y, xi_inv);
-  // x: v^2 slot of the w^0 part
-  r.x.a = {F2_ZERO_, F2_ZERO_, xs};
-  r.x.b = F6_ZERO_;
-  // y: v^1 slot of the w^1 part
-  r.y.a = F6_ZERO_;
-  r.y.b = {F2_ZERO_, ys, F2_ZERO_};
-  r.inf = q.inf;
-}
-
-static void embed_fp(F12& r, const Fp& a) {
-  r.a = {{a, FP_ZERO}, F2_ZERO_, F2_ZERO_};
-  r.b = F6_ZERO_;
-}
-
 static const u64 BLS_X_ABS = 0xD201000000010000ULL;
 
-// One Miller step: evaluate the line through r1, r2 at pt AND advance the
-// point — sharing the one lambda (and its Fp12 inversion, the dominant
-// cost) between the two, instead of linefunc + pt_add each inverting.
-// Degenerate cases (vertical line / infinity) mirror bls.py's linefunc.
-static void line_and_add(F12& l, Pt<F12>& rnew, const Pt<F12>& r1,
-                         const Pt<F12>& r2, const Pt<F12>& pt) {
-  F12 lam, t, d, di;
-  if (!f12_eq(r1.x, r2.x)) {
-    f12_sub(t, r2.y, r1.y);
-    f12_sub(d, r2.x, r1.x);
-    f12_inv(di, d);
-    f12_mul(lam, t, di);
-  } else if (f12_eq(r1.y, r2.y)) {
-    // tangent: lam = 3x^2 / 2y (y == 0 cannot occur for order-r points)
-    F12 x2, three, two;
-    Fp fp3, fp2v;
-    u64 raw3[6] = {3, 0, 0, 0, 0, 0}, raw2[6] = {2, 0, 0, 0, 0, 0};
-    fp_from_limbs(fp3, raw3);
-    fp_from_limbs(fp2v, raw2);
-    embed_fp(three, fp3);
-    embed_fp(two, fp2v);
-    f12_mul(x2, r1.x, r1.x);
-    f12_mul(t, three, x2);
-    f12_mul(d, two, r1.y);
-    f12_inv(di, d);
-    f12_mul(lam, t, di);
-  } else {
-    f12_sub(l, pt.x, r1.x);  // vertical: line only, sum is infinity
-    rnew = {r1.x, r1.y, true};
-    return;
-  }
-  // line value at pt
-  F12 u1, u2;
-  f12_sub(t, pt.x, r1.x);
-  f12_mul(u1, lam, t);
-  f12_sub(u2, pt.y, r1.y);
-  f12_sub(l, u1, u2);
-  // chord/tangent addition with the same lambda
-  F12 x3, y3;
-  f12_mul(x3, lam, lam);
-  f12_sub(x3, x3, r1.x);
-  f12_sub(x3, x3, r2.x);
-  f12_sub(t, r1.x, x3);
-  f12_mul(y3, lam, t);
-  f12_sub(y3, y3, r1.y);
-  rnew = {x3, y3, false};
+// Projective, inversion-free Miller loop on the twist.
+//
+// Every point in the loop is the untwist psi(x', y') = (x' v^2/xi,
+// y' v w/xi) of a twist point, so the walker stays on E'(Fp2) in
+// homogeneous projective coordinates and the line through two untwisted
+// points, evaluated at the embedded P = (xp, yp), is SPARSE:
+//
+//   l = c0 * 1 + c1 * (v w) + c2 * (v^2 w),   c_i in Fp2
+//
+// with (after clearing denominators by an Fp2 scale factor, which is
+// harmless: any c in Fp2* satisfies c^(p^2-1) = 1, so it dies in the
+// (p^6-1)(p^2+1) easy part of the final exponentiation)
+//
+//   doubling  (W = 3X^2, S = Y Z):  c0 = -yp * 2 S Z xi,
+//             c1 = 2 S Y - W X,     c2 = W Z xp
+//   addition  (D = x2 Z - X, E = y2 Z - Y):  c0 = -yp * D Z xi,
+//             c1 = D Y - E X,       c2 = E Z xp
+//
+// Point updates are the standard a=0 projective formulas (EFD dbl-2007-bl
+// / madd-1998-cmo); no field inversion anywhere in the loop.
+
+struct TwistPt {
+  F2 X, Y, Z;
+  bool inf;
+};
+
+// multiply an Fp2 element by an embedded Fp scalar
+static inline void f2_mul_fp(F2& r, const F2& x, const Fp& s) {
+  fp_mul(r.a, x.a, s);
+  fp_mul(r.b, x.b, s);
+}
+
+// f *= (c0 + c1 (v w) + c2 (v^2 w)):  with L = (0, c1, c2) in Fp6,
+//   out.a = f.a * c0 + v * (f.b * L)
+//   out.b = f.b * c0 + f.a * L
+// where * L exploits L's zero c0 slot (6 Fp2 muls per product).
+static void f6_mul_sparse12(F6& r, const F6& x, const F2& b1, const F2& b2) {
+  F2 t11, t22, s, u1, u2, c0, c1, c2;
+  f2_mul(t11, x.c1, b1);
+  f2_mul(t22, x.c2, b2);
+  f2_mul(u1, x.c1, b2);
+  f2_mul(u2, x.c2, b1);
+  f2_add(s, u1, u2);
+  f2_mul_xi(c0, s);
+  f2_mul(u1, x.c0, b1);
+  f2_mul_xi(s, t22);
+  f2_add(c1, u1, s);
+  f2_mul(u2, x.c0, b2);
+  f2_add(c2, u2, t11);
+  r.c0 = c0;
+  r.c1 = c1;
+  r.c2 = c2;
+}
+
+static void f6_scale(F6& r, const F6& x, const F2& s) {
+  f2_mul(r.c0, x.c0, s);
+  f2_mul(r.c1, x.c1, s);
+  f2_mul(r.c2, x.c2, s);
+}
+
+static void mul_by_line(F12& f, const F2& c0, const F2& c1, const F2& c2) {
+  F6 aL, bL, ac, bc;
+  f6_mul_sparse12(bL, f.b, c1, c2);
+  f6_mul_v(bL, bL);
+  f6_mul_sparse12(aL, f.a, c1, c2);
+  f6_scale(ac, f.a, c0);
+  f6_scale(bc, f.b, c0);
+  f6_add(f.a, ac, bL);
+  f6_add(f.b, bc, aL);
+}
+
+// doubling step: T <- 2T, line coefficients out
+static void dbl_step(TwistPt& T, F2& c0, F2& c1, F2& c2, const Fp& xp,
+                     const Fp& nyp) {
+  F2 W, S, B, H, t, Y2, S2;
+  f2_sq(t, T.X);
+  f2_add(W, t, t);
+  f2_add(W, W, t);          // W = 3 X^2
+  f2_mul(S, T.Y, T.Z);      // S = Y Z
+  f2_mul(t, T.X, T.Y);
+  f2_mul(B, t, S);          // B = X Y S
+  f2_sq(t, W);
+  F2 eightB;
+  f2_add(eightB, B, B);
+  f2_add(eightB, eightB, eightB);
+  f2_add(eightB, eightB, eightB);
+  f2_sub(H, t, eightB);     // H = W^2 - 8B
+  // line first (it reads X, Y, Z before the update)
+  F2 twoS;
+  f2_add(twoS, S, S);
+  f2_mul(t, twoS, T.Z);
+  f2_mul_xi(t, t);
+  f2_mul_fp(c0, t, nyp);    // c0 = -yp * 2 S Z xi
+  f2_mul(t, twoS, T.Y);
+  F2 WX;
+  f2_mul(WX, W, T.X);
+  f2_sub(c1, t, WX);        // c1 = 2 S Y - W X
+  f2_mul(t, W, T.Z);
+  f2_mul_fp(c2, t, xp);     // c2 = W Z xp
+  // point update
+  F2 X3, Y3, Z3, fourB;
+  f2_mul(t, H, S);
+  f2_add(X3, t, t);         // X3 = 2 H S
+  f2_add(fourB, B, B);
+  f2_add(fourB, fourB, fourB);
+  f2_sub(t, fourB, H);
+  f2_mul(t, W, t);
+  f2_sq(Y2, T.Y);
+  F2 SS;
+  f2_sq(SS, S);
+  f2_mul(S2, Y2, SS);       // Y^2 S^2
+  F2 eightY2S2;
+  f2_add(eightY2S2, S2, S2);
+  f2_add(eightY2S2, eightY2S2, eightY2S2);
+  f2_add(eightY2S2, eightY2S2, eightY2S2);
+  f2_sub(Y3, t, eightY2S2); // Y3 = W(4B - H) - 8 Y^2 S^2
+  f2_mul(Z3, SS, S);
+  f2_add(Z3, Z3, Z3);
+  f2_add(Z3, Z3, Z3);
+  f2_add(Z3, Z3, Z3);       // Z3 = 8 S^3
+  T.X = X3;
+  T.Y = Y3;
+  T.Z = Z3;
+  T.inf = f2_is_zero(Z3);
+}
+
+// mixed addition step: T <- T + Q (Q affine on the twist), line out.
+// Returns false for the degenerate T == +/-Q cases (caller handles).
+static bool add_step(TwistPt& T, const Pt<F2>& Q, F2& c0, F2& c1, F2& c2,
+                     const Fp& xp, const Fp& nyp) {
+  F2 D, E, t;
+  f2_mul(t, Q.x, T.Z);
+  f2_sub(D, t, T.X);        // D = x2 Z - X
+  f2_mul(t, Q.y, T.Z);
+  f2_sub(E, t, T.Y);        // E = y2 Z - Y
+  if (f2_is_zero(D)) return false;
+  // line
+  F2 DZ;
+  f2_mul(DZ, D, T.Z);
+  f2_mul_xi(t, DZ);
+  f2_mul_fp(c0, t, nyp);    // c0 = -yp * D Z xi
+  F2 DY, EX;
+  f2_mul(DY, D, T.Y);
+  f2_mul(EX, E, T.X);
+  f2_sub(c1, DY, EX);       // c1 = D Y - E X
+  f2_mul(t, E, T.Z);
+  f2_mul_fp(c2, t, xp);     // c2 = E Z xp
+  // point update (madd-1998-cmo): A = E^2 Z - D^3 - 2 D^2 X
+  F2 D2, D3, E2, A, D2X;
+  f2_sq(D2, D);
+  f2_mul(D3, D2, D);
+  f2_sq(E2, E);
+  f2_mul(t, E2, T.Z);
+  f2_mul(D2X, D2, T.X);
+  f2_sub(A, t, D3);
+  f2_sub(A, A, D2X);
+  f2_sub(A, A, D2X);
+  F2 X3, Y3, Z3;
+  f2_mul(X3, D, A);
+  f2_sub(t, D2X, A);
+  f2_mul(t, E, t);
+  F2 D3Y;
+  f2_mul(D3Y, D3, T.Y);
+  f2_sub(Y3, t, D3Y);
+  f2_mul(Z3, D3, T.Z);
+  T.X = X3;
+  T.Y = Y3;
+  T.Z = Z3;
+  T.inf = f2_is_zero(Z3);
+  return true;
 }
 
 static void miller(F12& f, const Pt<Fp>& p1, const Pt<F2>& q2) {
-  if (p1.inf || q2.inf) {
-    f = F12_ONE_;
-    return;
-  }
-  Pt<F12> q, pt, r;
-  untwist(q, q2);
-  F12 px, py;
-  embed_fp(px, p1.x);
-  embed_fp(py, p1.y);
-  pt = {px, py, false};
   f = F12_ONE_;
-  r = q;
-  // MSB-first over bits of |x| below the leading bit
+  if (p1.inf || q2.inf) return;
+  Fp nyp;
+  fp_neg(nyp, p1.y);
+  TwistPt T = {q2.x, q2.y, F2_ONE_, false};
+  F2 c0, c1, c2;
   int top = 63;
   while (!((BLS_X_ABS >> top) & 1)) top--;
   for (int b = top - 1; b >= 0; b--) {
-    F12 l;
-    Pt<F12> rn;
-    if (r.inf) {
-      l = F12_ONE_;  // line through infinity contributes nothing
-    } else {
-      line_and_add(l, rn, r, r, pt);
-      r = rn;
-    }
     f12_sq(f, f);
-    f12_mul(f, f, l);
+    if (!T.inf) {
+      dbl_step(T, c0, c1, c2, p1.x, nyp);
+      mul_by_line(f, c0, c1, c2);
+    }
     if ((BLS_X_ABS >> b) & 1) {
-      if (r.inf) {
-        r = q;  // inf + q
+      if (T.inf) {
+        T = {q2.x, q2.y, F2_ONE_, false};  // inf + Q
+      } else if (add_step(T, q2, c0, c1, c2, p1.x, nyp)) {
+        mul_by_line(f, c0, c1, c2);
       } else {
-        line_and_add(l, rn, r, q, pt);
-        f12_mul(f, f, l);
-        r = rn;
+        // x-coords match: T == +/-Q.  Only reachable via hostile
+        // non-subgroup inputs; handle both soundly.
+        F2 E, t;
+        f2_mul(t, q2.y, T.Z);
+        f2_sub(E, t, T.Y);
+        if (f2_is_zero(E)) {
+          // T == Q: the addition is a doubling
+          dbl_step(T, c0, c1, c2, p1.x, nyp);
+          mul_by_line(f, c0, c1, c2);
+        } else {
+          // T == -Q: vertical line l = xp - x_T (scaled by Z xi),
+          // sparse in the w^0 part: xp Z xi - X v^2; sum is infinity
+          F12 l;
+          F2 nx;
+          l.b = F6_ZERO_;
+          f2_mul_xi(t, T.Z);
+          f2_mul_fp(l.a.c0, t, p1.x);
+          l.a.c1 = F2_ZERO_;
+          f2_neg(nx, T.X);
+          l.a.c2 = nx;
+          f12_mul(f, f, l);
+          T.inf = true;
+        }
       }
     }
   }
