@@ -96,7 +96,10 @@ class Message:
         _check_depth(d)
         d = dict(d)
         kind = d.pop("kind", None)
-        cls = _REGISTRY.get(kind)
+        # kind must be hashable AND known: a {"kind": [...]} packet must
+        # raise ValueError like every other malformation, not TypeError
+        # from the dict lookup (found by the wire fuzzer)
+        cls = _REGISTRY.get(kind) if isinstance(kind, str) else None
         if cls is None:
             raise ValueError(f"unknown message kind: {kind!r}")
         return cls._build(d)
