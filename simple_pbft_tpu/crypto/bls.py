@@ -543,15 +543,24 @@ def keygen(seed: bytes) -> Tuple[int, bytes]:
     ) % R_ORDER
     if sk == 0:
         sk = 1
-    return sk, _g2_to_bytes(G2.mul_pt(G2_GEN, sk))
+    pk = _native().bls_pubkey(sk)
+    if pk is None:
+        pk = _g2_to_bytes(G2.mul_pt(G2_GEN, sk))
+    return sk, pk
 
 
 def sign(sk: int, msg: bytes) -> bytes:
+    s = _native().bls_sign(sk, msg, DST_SIG)
+    if s is not None:
+        return s
     return _g1_to_bytes(G1.mul_pt(hash_to_g1(msg), sk))
 
 
 def pop_prove(sk: int, pubkey: bytes) -> bytes:
     """Proof of possession: sign your own pubkey under the PoP domain."""
+    s = _native().bls_sign(sk, pubkey, DST_POP)
+    if s is not None:
+        return s
     return _g1_to_bytes(G1.mul_pt(hash_to_g1(pubkey, DST_POP), sk))
 
 

@@ -121,14 +121,26 @@ def _load_bls() -> Optional[ctypes.CDLL]:
             return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i64 = ctypes.c_int64
-        lib.bls_verify_one.argtypes = [
-            u8p, u8p, i64, u8p, u8p, i64, ctypes.c_int,
-        ]
-        lib.bls_verify_one.restype = ctypes.c_int
-        lib.bls_verify_aggregate.argtypes = [u8p, i64, u8p, i64, u8p, u8p, i64]
-        lib.bls_verify_aggregate.restype = ctypes.c_int
-        lib.bls_selftest.argtypes = []
-        lib.bls_selftest.restype = ctypes.c_int
+        try:
+            lib.bls_verify_one.argtypes = [
+                u8p, u8p, i64, u8p, u8p, i64, ctypes.c_int,
+            ]
+            lib.bls_verify_one.restype = ctypes.c_int
+            lib.bls_verify_aggregate.argtypes = [
+                u8p, i64, u8p, i64, u8p, u8p, i64,
+            ]
+            lib.bls_verify_aggregate.restype = ctypes.c_int
+            lib.bls_sign.argtypes = [u8p, u8p, i64, u8p, i64, u8p]
+            lib.bls_sign.restype = ctypes.c_int
+            lib.bls_pubkey.argtypes = [u8p, u8p]
+            lib.bls_pubkey.restype = ctypes.c_int
+            lib.bls_selftest.argtypes = []
+            lib.bls_selftest.restype = ctypes.c_int
+        except AttributeError as e:
+            # a stale cached .so missing newer exports (e.g. source file
+            # absent so no rebuild happened): degrade to the Python path
+            log.warning("bls381 stale/incomplete: %s — Python fallback", e)
+            return None
         if lib.bls_selftest() != 1:
             log.warning("bls381 selftest FAILED — using Python fallback")
             return None
@@ -159,6 +171,30 @@ def bls_verify_one(
         len(dst), 1 if check_pk else 0,
     )
     return bool(r)
+
+
+def bls_sign(sk: int, msg: bytes, dst: bytes) -> Optional[bytes]:
+    """Native BLS sign (bit-identical to the Python path — deterministic
+    hash-and-multiply); None = library unavailable."""
+    lib = _load_bls()
+    if lib is None:
+        return None
+    out = (ctypes.c_uint8 * 96)()
+    r = lib.bls_sign(
+        _cbuf(sk.to_bytes(32, "big")), _cbuf(msg), len(msg), _cbuf(dst),
+        len(dst), out,
+    )
+    return bytes(out) if r else None
+
+
+def bls_pubkey(sk: int) -> Optional[bytes]:
+    """Native G2 pubkey derivation; None = library unavailable."""
+    lib = _load_bls()
+    if lib is None:
+        return None
+    out = (ctypes.c_uint8 * 192)()
+    r = lib.bls_pubkey(_cbuf(sk.to_bytes(32, "big")), out)
+    return bytes(out) if r else None
 
 
 def bls_verify_aggregate(

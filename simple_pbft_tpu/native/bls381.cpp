@@ -1465,6 +1465,34 @@ int dbg_miller_one(const uint8_t* p96, const uint8_t* q192) {
   return pairings_equal(p, q, p, q) ? 1 : 0;
 }
 
+// sk (32-byte big-endian scalar, already reduced mod r) signs msg under
+// dst: sig = hash_to_g1(msg, dst)^sk, 96-byte uncompressed out.
+// 1 = ok, 0 = degenerate (zero scalar / infinity result).
+int bls_sign(const uint8_t* sk_be, const uint8_t* msg, int64_t msg_len,
+             const uint8_t* dst, int64_t dst_len, uint8_t* out96) {
+  bls_init();
+  Pt<Fp> h = hash_to_g1(msg, (size_t)msg_len, dst, (size_t)dst_len);
+  bool fail = false;
+  Pt<Fp> s = pt_mul(h, sk_be, 32, &fail);
+  if (fail || s.inf) return 0;
+  fp_to_be(out96, s.x);
+  fp_to_be(out96 + 48, s.y);
+  return 1;
+}
+
+// pubkey = G2_gen^sk, 192-byte uncompressed out. 1 = ok, 0 = degenerate.
+int bls_pubkey(const uint8_t* sk_be, uint8_t* out192) {
+  bls_init();
+  bool fail = false;
+  Pt<F2> pk = pt_mul(G2_GEN_, sk_be, 32, &fail);
+  if (fail || pk.inf) return 0;
+  fp_to_be(out192, pk.x.a);
+  fp_to_be(out192 + 48, pk.x.b);
+  fp_to_be(out192 + 96, pk.y.a);
+  fp_to_be(out192 + 144, pk.y.b);
+  return 1;
+}
+
 // self-test hook: e(G1gen, G2gen)^r == 1 and bilinearity smoke
 int bls_selftest(void) {
   bls_init();

@@ -136,6 +136,44 @@ def test_aggregate_and_pop(keys):
     assert not bls.pop_verify(keys[1][1], pop)
 
 
+def test_native_sign_bit_identical_to_python(monkeypatch):
+    """Ed25519-style determinism: the native sign/keygen path must emit
+    byte-identical signatures and pubkeys to the bigint path (same
+    hash-to-G1, same scalar multiple, same canonical serialization)."""
+    from simple_pbft_tpu import native
+
+    if not native.bls_available():
+        pytest.skip("no native toolchain")
+    seed = bytes([0x5A]) * 32
+    msg = b"determinism probe"
+    sk_n, pk_n = bls.keygen(seed)
+    sig_n = bls.sign(sk_n, msg)
+    pop_n = bls.pop_prove(sk_n, pk_n)
+
+    class _NoNative:
+        @staticmethod
+        def bls_sign(*a, **k):
+            return None
+
+        @staticmethod
+        def bls_pubkey(*a, **k):
+            return None
+
+        @staticmethod
+        def bls_verify_one(*a, **k):
+            return None
+
+        @staticmethod
+        def bls_verify_aggregate(*a, **k):
+            return None
+
+    monkeypatch.setattr(bls, "_native", lambda: _NoNative)
+    sk_p, pk_p = bls.keygen(seed)
+    assert (sk_n, pk_n) == (sk_p, pk_p)
+    assert bls.sign(sk_p, msg) == sig_n
+    assert bls.pop_prove(sk_p, pk_p) == pop_n
+
+
 def test_native_and_python_paths_agree(keys, monkeypatch):
     """Differential check: the C++ pairing library (native/bls381.cpp)
     and this module's bigint path must return identical verdicts on
