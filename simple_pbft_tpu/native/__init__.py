@@ -175,7 +175,10 @@ def bls_verify_one(
 
 def bls_sign(sk: int, msg: bytes, dst: bytes) -> Optional[bytes]:
     """Native BLS sign (bit-identical to the Python path — deterministic
-    hash-and-multiply); None = library unavailable."""
+    hash-and-multiply); None = unavailable (caller falls back, including
+    out-of-range scalars the bigint path accepts)."""
+    if not 0 <= sk < (1 << 256):
+        return None
     lib = _load_bls()
     if lib is None:
         return None
@@ -188,7 +191,10 @@ def bls_sign(sk: int, msg: bytes, dst: bytes) -> Optional[bytes]:
 
 
 def bls_pubkey(sk: int) -> Optional[bytes]:
-    """Native G2 pubkey derivation; None = library unavailable."""
+    """Native G2 pubkey derivation; None = unavailable (caller falls
+    back, including out-of-range scalars)."""
+    if not 0 <= sk < (1 << 256):
+        return None
     lib = _load_bls()
     if lib is None:
         return None
