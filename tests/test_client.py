@@ -1,0 +1,152 @@
+"""Client library: f+1 reply matching under forged and late replies.
+
+The client skips signature checks for replies no waiter needs (a
+throughput optimization) — these tests pin that verification still
+gates every reply that CAN affect a result.
+"""
+
+import asyncio
+
+from simple_pbft_tpu.client import Client
+from simple_pbft_tpu.config import make_test_committee
+from simple_pbft_tpu.crypto.signer import Signer
+from simple_pbft_tpu.messages import Reply
+
+
+class FakeTransport:
+    """Message sink + injectable inbox (no network)."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self.q: asyncio.Queue = asyncio.Queue()
+        self.sent = []
+
+    async def send(self, dest, raw):
+        self.sent.append((dest, raw))
+
+    async def broadcast(self, raw, dests):
+        self.sent.append(("*", raw))
+
+    async def recv(self):
+        return await self.q.get()
+
+    def recv_nowait(self):
+        try:
+            return self.q.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+
+
+def run(coro, timeout=30):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def _reply(rid, result, ts=1, view=0):
+    return Reply(sender=rid, view=view, seq=1, client_id="c0", timestamp=ts,
+                 result=result)
+
+
+def test_forged_replies_never_match_and_valid_ones_do():
+    async def scenario():
+        cfg, keys = make_test_committee(n=4, clients=1)
+        t = FakeTransport("c0")
+        client = Client(client_id="c0", cfg=cfg, seed=keys["c0"].seed,
+                        transport=t, request_timeout=2.0)
+        client.start()
+        task = asyncio.create_task(client.submit("op x", retries=0))
+        await asyncio.sleep(0.05)  # waiter for ts=1 registers
+        # forged: signed by a key that is not the claimed sender's
+        forger = Signer("evil", b"\xee" * 32)
+        for rid in ("r0", "r1", "r2"):
+            msg = _reply(rid, "EVIL")
+            forger.sign_msg(msg)
+            msg.sender = rid
+            await t.q.put(msg.to_wire())
+        # non-replica sender with a valid-for-itself signature
+        msg = _reply("nobody", "EVIL")
+        forger.sign_msg(msg)
+        await t.q.put(msg.to_wire())
+        await asyncio.sleep(0.2)
+        assert not task.done(), "forged replies must never reach f+1"
+        # two honest matching replies (f+1 for n=4) resolve it
+        for rid in ("r0", "r1"):
+            msg = _reply(rid, "ok")
+            Signer(rid, keys[rid].seed).sign_msg(msg)
+            await t.q.put(msg.to_wire())
+        assert await task == "ok"
+        await client.stop()
+
+    run(scenario())
+
+
+class CountingVerifier:
+    """Real CPU verification plus a call counter — observes whether the
+    client pays signature work for a reply."""
+
+    def __init__(self):
+        from simple_pbft_tpu.crypto.verifier import best_cpu_verifier
+
+        self.inner = best_cpu_verifier()
+        self.calls = 0
+
+    def verify_batch(self, items):
+        self.calls += len(items)
+        return self.inner.verify_batch(items)
+
+
+def test_late_replies_after_match_skip_signature_work():
+    async def scenario():
+        cfg, keys = make_test_committee(n=4, clients=1)
+        t = FakeTransport("c0")
+        counter = CountingVerifier()
+        client = Client(client_id="c0", cfg=cfg, seed=keys["c0"].seed,
+                        transport=t, request_timeout=2.0,
+                        verifier=counter)
+        client.start()
+        task = asyncio.create_task(client.submit("op y", retries=0))
+        await asyncio.sleep(0.05)
+        for rid in ("r0", "r1"):
+            msg = _reply(rid, "done")
+            Signer(rid, keys[rid].seed).sign_msg(msg)
+            await t.q.put(msg.to_wire())
+        assert await task == "done"
+        verified_during_match = counter.calls
+        assert verified_during_match == 2  # both active replies verified
+        # late replies for the resolved timestamp: the recv loop must
+        # drop them BEFORE verification (the throughput optimization
+        # this suite pins) — the counter must not move
+        for rid in ("r2", "r3"):
+            msg = _reply(rid, "divergent")
+            Signer(rid, keys[rid].seed).sign_msg(msg)
+            await t.q.put(msg.to_wire())
+        await asyncio.sleep(0.1)
+        assert counter.calls == verified_during_match
+        await client.stop()
+
+    run(scenario())
+
+
+def test_conflicting_results_wait_for_true_quorum():
+    async def scenario():
+        cfg, keys = make_test_committee(n=4, clients=1)
+        t = FakeTransport("c0")
+        client = Client(client_id="c0", cfg=cfg, seed=keys["c0"].seed,
+                        transport=t, request_timeout=2.0)
+        client.start()
+        task = asyncio.create_task(client.submit("op z", retries=0))
+        await asyncio.sleep(0.05)
+        # two replicas disagree (one Byzantine): no f+1 match yet
+        for rid, res in (("r0", "A"), ("r1", "B")):
+            msg = _reply(rid, res)
+            Signer(rid, keys[rid].seed).sign_msg(msg)
+            await t.q.put(msg.to_wire())
+        await asyncio.sleep(0.2)
+        assert not task.done()
+        # a third replica agreeing with A completes f+1 on A
+        msg = _reply("r2", "A")
+        Signer("r2", keys["r2"].seed).sign_msg(msg)
+        await t.q.put(msg.to_wire())
+        assert await task == "A"
+        await client.stop()
+
+    run(scenario())
