@@ -345,3 +345,38 @@ def test_wire_caps_are_per_type():
                     checkpoint_proof=[{"pad": big}], prepared_proofs=[])
     decoded = Message.from_wire(vc.to_wire())
     assert isinstance(decoded, ViewChange)
+
+
+def test_vc_replay_buffer_feeds_window_laggards():
+    """NEW-VIEW pre-prepares beyond a lagging replica's watermark window
+    are buffered at install and replayed once the window advances —
+    without the buffer the replica silently skips those slots forever
+    (advisor finding). Also: entries from superseded views are dropped."""
+
+    async def main():
+        c = LocalCommittee.build(n=4, view_timeout=0, watermark_window=4)
+        r = c.replica("r1")
+        assert r.stable_seq == 0  # window is (0, 4]
+
+        # a certificate pre-prepare beyond the window (seq 7, view 0)
+        _, pp_beyond = _prepared_proof(c.cfg, c.keys, view=0, seq=7)
+        # and one from a view this replica will never be in
+        _, pp_stale = _prepared_proof(c.cfg, c.keys, view=3, seq=6)
+        r.vc_replay[7] = pp_beyond
+        r.vc_replay[6] = pp_stale
+
+        # window still lags: replay must keep the in-view entry buffered
+        await r._replay_vc_buffer()
+        assert 7 in r.vc_replay
+        assert 6 not in r.vc_replay  # superseded view dropped
+        assert (0, 7) not in r.instances
+
+        # state transfer advances the stable checkpoint; the buffered
+        # pre-prepare must now be consumed into a live instance
+        r.stable_seq = 4
+        await r._replay_vc_buffer()
+        assert 7 not in r.vc_replay
+        inst = r.instances.get((0, 7))
+        assert inst is not None and inst.pre_prepare is not None
+
+    _run(main())
