@@ -176,6 +176,17 @@ async def run_config(
     exec_counts = sorted(
         r.metrics.get("committed_requests", 0) for r in com.replicas if r._running
     )
+    if storm:
+        # certificate-size evidence: the qc_mode claim is smaller failover
+        # certificates — report the biggest ones actually built
+        crash_info["max_viewchange_bytes"] = max(
+            (r.metrics.get("max_viewchange_bytes", 0) for r in com.replicas),
+            default=0,
+        )
+        crash_info["max_newview_bytes"] = max(
+            (r.metrics.get("max_newview_bytes", 0) for r in com.replicas),
+            default=0,
+        )
     await com.stop()
 
     lat_ms = sorted(x * 1e3 for x in latencies)

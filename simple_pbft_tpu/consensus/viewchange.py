@@ -389,6 +389,12 @@ class ViewChanger:
                 "reduce max_batch/watermark_window",
                 self.r.id, new_view, len(vc.prepared_proofs),
             )
+        # certificate-size observability: the qc_mode-vs-plain storm
+        # comparison hinges on these (a QC VIEW-CHANGE is O(1), a plain
+        # one embeds full request blocks per prepared seq)
+        self.r.metrics["max_viewchange_bytes"] = max(
+            self.r.metrics.get("max_viewchange_bytes", 0), len(wire)
+        )
         await self.r.transport.broadcast(wire, self.r.cfg.replica_ids)
         await self.on_view_change(vc)  # count our own
 
@@ -495,7 +501,20 @@ class ViewChanger:
         r.signer.sign_msg(nv)
         self.new_view_sent.add(new_view)
         r.metrics["new_views_sent"] += 1
-        await r.transport.broadcast(nv.to_wire(), r.cfg.replica_ids)
+        nv_wire = nv.to_wire()
+        r.metrics["max_newview_bytes"] = max(
+            r.metrics.get("max_newview_bytes", 0), len(nv_wire)
+        )
+        if len(nv_wire) > NewView.MAX_WIRE_BYTES:
+            # undeliverable: every receiver's from_wire drops it and
+            # failover stalls — same guard as the VIEW-CHANGE path
+            r.metrics["newview_oversized"] += 1
+            log.error(
+                "%s: NEW-VIEW(%d) exceeds wire cap (%d B); reduce "
+                "max_batch/watermark_window",
+                r.id, new_view, len(nv_wire),
+            )
+        await r.transport.broadcast(nv_wire, r.cfg.replica_ids)
         await self.on_new_view(nv)  # install locally
 
     async def on_new_view(self, msg: NewView) -> None:
