@@ -40,6 +40,9 @@ from ..crypto.signer import Signer
 from ..crypto.verifier import BatchItem, Verifier, best_cpu_verifier
 from ..logutil import ReplicaStats
 from ..messages import (
+    EMPTY_BLOCK_DIGEST,
+    BlockFetch,
+    BlockReply,
     Checkpoint,
     Commit,
     Message,
@@ -117,6 +120,12 @@ class Replica:
         # NEW-VIEW pre-prepares beyond our lagging watermark window,
         # replayed after state transfer advances stable_seq
         self.vc_replay: Dict[int, PrePrepare] = {}
+        # blocks by digest: certificates ship digest-only pre-prepares
+        # (messages.PrePrepare.signing_payload), so installs refill from
+        # here; GC'd against the stable watermark via the seq binding
+        self.block_store: Dict[str, Tuple[int, List[Dict[str, Any]]]] = {}
+        # detached re-issues awaiting a BlockReply, by digest (bounded)
+        self.block_pending: Dict[str, PrePrepare] = {}
         self.vc = ViewChanger(self)
         # QC mode: BLS share-signing key + per-(view, seq, phase) record of
         # certificates this replica (as primary) already aggregated
@@ -195,6 +204,10 @@ class Replica:
         still register as outstanding or the failover timer fires into a
         no-op and the view wedges."""
         if self.relay_buffer or self.pending_requests:
+            return True
+        if self.block_pending:
+            # detached re-issues awaiting a block fetch: if no peer ever
+            # answers, the timer must fire and move the view again
             return True
         # only CURRENT-view proposals count: an orphan pre-prepare from a
         # dead view (primary crashed pre-quorum, O-set dropped the seq) is
@@ -354,7 +367,7 @@ class Replica:
         if isinstance(
             msg,
             (PrePrepare, Prepare, Commit, Checkpoint, ViewChange, NewView,
-             QuorumCert, StateRequest, StateResponse),
+             QuorumCert, StateRequest, StateResponse, BlockFetch, BlockReply),
         ):
             if msg.sender not in self._replica_set:
                 return []
@@ -438,6 +451,10 @@ class Replica:
             await self._on_state_request(msg)
         elif isinstance(msg, StateResponse):
             await self._on_state_response(msg)
+        elif isinstance(msg, BlockFetch):
+            await self._on_block_fetch(msg)
+        elif isinstance(msg, BlockReply):
+            await self._on_block_reply(msg)
         elif isinstance(msg, (ViewChange, NewView)):
             await self._on_view_message(msg)
         else:
@@ -549,6 +566,10 @@ class Replica:
             actions = inst.on_pre_prepare(msg)
             if inst.pre_prepare is not None and inst.t_started == 0.0:
                 inst.t_started = time.perf_counter()  # commit-latency clock
+            if inst.pre_prepare is msg:
+                # admitted (digest verified by the instance): remember the
+                # block so digest-only certificates can be refilled later
+                self.store_block(msg.seq, msg.digest, msg.block)
         elif isinstance(msg, Prepare):
             actions = inst.on_prepare(msg)
         else:
@@ -825,6 +846,123 @@ class Replica:
         self._advance_stable(seq)
         await self._replay_vc_buffer()
 
+    # ------------------------------------------------------------------
+    # block store + fetch (digest-only certificates refill here)
+    # ------------------------------------------------------------------
+
+    MAX_PENDING_BLOCKS = 1024  # detached re-issues awaiting fetch
+
+    def store_block(self, seq: int, digest: str, block) -> None:
+        """Remember an admitted block by digest (highest seq binding wins
+        — GC prunes by the stable watermark)."""
+        cur = self.block_store.get(digest)
+        if cur is None or seq > cur[0]:
+            self.block_store[digest] = (seq, block)
+
+    def resolve_block(self, pp: PrePrepare) -> Optional[PrePrepare]:
+        """Fill a detached pre-prepare's block from the store. Returns the
+        filled message (signature stays valid — it covers the digest, not
+        the block) or None if the block must be fetched."""
+        if pp.block or pp.digest == EMPTY_BLOCK_DIGEST:
+            return pp  # already carries its block, or the no-op block
+        ent = self.block_store.get(pp.digest)
+        if ent is None:
+            return None
+        return PrePrepare(
+            sender=pp.sender, sig=pp.sig, view=pp.view, seq=pp.seq,
+            digest=pp.digest, block=ent[1],
+        )
+
+    def buffer_for_block(self, pp: PrePrepare) -> None:
+        if len(self.block_pending) < self.MAX_PENDING_BLOCKS:
+            self.block_pending[pp.digest] = pp
+        else:
+            self.metrics["block_pending_overflow"] += 1
+
+    def prune_stale_block_pending(self, new_view: int) -> None:
+        """Entries buffered under earlier views are dead: the new install
+        re-buffers (and re-requests) whatever its own O-set still needs,
+        and a stale entry would otherwise hold has_outstanding_work()
+        true forever, firing the failover timer on an idle committee."""
+        self.block_pending = {
+            dg: pp for dg, pp in self.block_pending.items()
+            if pp.view >= new_view
+        }
+
+    async def request_blocks(self, digests: List[str]) -> None:
+        """Ask f+1 peers for blocks behind re-issued digests — at least
+        one is honest and (having contributed a prepared certificate or
+        validated the NEW-VIEW) holds them; a broadcast would n-fold the
+        multi-MB replies during failover congestion. Liveness fallback:
+        if no targeted peer answers, the view-change timer fires again."""
+        peers = [r for r in self.cfg.replica_ids if r != self.id]
+        targets = peers[: self.cfg.weak_quorum]
+        want = sorted(set(digests))
+        for start in range(0, len(want), 256):  # chunk, don't truncate
+            fetch = BlockFetch(digests=want[start : start + 256])
+            self.signer.sign_msg(fetch)
+            self.metrics["block_fetches_sent"] += 1
+            wire = fetch.to_wire()
+            for peer in targets:
+                await self.transport.send(peer, wire)
+
+    # soft byte budget per BlockReply: stay far under the wire cap and
+    # chunk large responses instead of building one undeliverable frame
+    BLOCK_REPLY_SOFT_BYTES = 4 * 1024 * 1024
+
+    async def _on_block_fetch(self, msg: BlockFetch) -> None:
+        if not isinstance(msg.digests, list):
+            return
+        found = []
+        approx = 0
+        for dg in msg.digests[:256]:
+            ent = self.block_store.get(dg) if isinstance(dg, str) else None
+            if ent is None:
+                continue
+            found.append({"digest": dg, "block": ent[1]})
+            approx += sum(len(str(rd)) for rd in ent[1]) + 128
+            if approx >= self.BLOCK_REPLY_SOFT_BYTES:
+                await self._send_block_reply(msg.sender, found)
+                found, approx = [], 0
+        if found:
+            await self._send_block_reply(msg.sender, found)
+
+    async def _send_block_reply(self, dest: str, entries) -> None:
+        reply = BlockReply(blocks=entries)
+        self.signer.sign_msg(reply)
+        await self.transport.send(dest, reply.to_wire())
+
+    async def _on_block_reply(self, msg: BlockReply) -> None:
+        """Self-authenticating: recompute each block's digest; mismatches
+        are dropped (the responder need not be trusted). Matching blocks
+        release any buffered detached pre-prepares — but only for the
+        CURRENT view: a late reply for a superseded view's digest must
+        not clobber the current view's replay slot."""
+        for ent in msg.blocks[:256]:
+            dg = ent.get("digest")
+            block = ent.get("block")
+            if not isinstance(dg, str) or not isinstance(block, list):
+                continue
+            if PrePrepare.block_digest(block) != dg:
+                self.metrics["bad_block_reply"] += 1
+                continue
+            pp = self.block_pending.pop(dg, None)
+            if pp is None:
+                continue
+            self.store_block(pp.seq, dg, block)
+            if pp.view != self.view:
+                self.metrics["stale_block_reply"] += 1
+                continue
+            filled = PrePrepare(
+                sender=pp.sender, sig=pp.sig, view=pp.view, seq=pp.seq,
+                digest=dg, block=block,
+            )
+            self.metrics["blocks_fetched"] += 1
+            if filled.seq > self.stable_seq + self.cfg.watermark_window:
+                self.vc_replay[filled.seq] = filled
+            else:
+                await self._on_phase(filled)
+
     async def _on_state_request(self, msg: StateRequest) -> None:
         snap = self.snapshots.get(msg.seq)
         if snap is None:
@@ -903,6 +1041,12 @@ class Replica:
         self.ready = {s: a for s, a in self.ready.items() if s > seq}
         self.vc_replay = {
             s: pp for s, pp in self.vc_replay.items() if s > seq
+        }
+        self.block_store = {
+            dg: (s, b) for dg, (s, b) in self.block_store.items() if s > seq
+        }
+        self.block_pending = {
+            dg: pp for dg, pp in self.block_pending.items() if pp.seq > seq
         }
         self._qc_sent = {k for k in self._qc_sent if k[1] > seq}
         self.seen_requests = {

@@ -240,19 +240,30 @@ class Instance:
 
     # -- view-change support -------------------------------------------------
 
+    def _detached_pre_prepare(self) -> Dict[str, Any]:
+        """The pre-prepare with its block stripped: the digest (which the
+        signature covers — PrePrepare.signing_payload detaches the block)
+        binds the content, so certificates ship digests and receivers
+        refill blocks locally or via BlockFetch. This is what keeps
+        VIEW-CHANGE/NEW-VIEW wires small under load."""
+        d = self.pre_prepare.to_dict()
+        d["block"] = []
+        return d
+
     def prepared_proof(self) -> Optional[Dict[str, Any]]:
         """If prepared, the certificate a VIEW-CHANGE message carries for
         this slot (Castro-Liskov P-set): {pre-prepare, 2f+1 prepares} —
         or, in QC mode, {pre-prepare, prepare_qc}: the aggregate IS the
         2f+1-signer certificate, one pairing check instead of 2f+1
-        signature checks and a fraction of the wire bytes."""
+        signature checks and a fraction of the wire bytes. Pre-prepares
+        ship digest-only (blocks detached)."""
         if self.qc_mode:
             if self.prepare_qc is None or self.pre_prepare is None:
                 return None
             if self.prepare_qc.digest != self.pre_prepare.digest:
                 return None
             return {
-                "pre_prepare": self.pre_prepare.to_dict(),
+                "pre_prepare": self._detached_pre_prepare(),
                 "prepare_qc": self.prepare_qc.to_dict(),
             }
         if not self.prepared():
@@ -263,6 +274,6 @@ class Instance:
             if p.digest == self.digest
         ]
         return {
-            "pre_prepare": self.pre_prepare.to_dict(),
+            "pre_prepare": self._detached_pre_prepare(),
             "prepares": votes[: self.quorum],
         }

@@ -37,6 +37,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..crypto.verifier import BatchItem
 from ..messages import (
+    EMPTY_BLOCK_DIGEST,
     Checkpoint,
     Commit,
     Message,
@@ -44,7 +45,6 @@ from ..messages import (
     PrePrepare,
     Prepare,
     QuorumCert,
-    Request,
     ViewChange,
 )
 from . import qc as qc_mod
@@ -98,7 +98,10 @@ def validate_prepared_proof(
         return None
     if pp.sender != cfg.primary(pp.view):
         return None
-    if PrePrepare.block_digest(pp.block) != pp.digest:
+    # P-set pre-prepares ship DETACHED (block == [], digest binds the
+    # content — the signature covers the digest, not the block). A proof
+    # that does carry a block must be consistent with its digest.
+    if pp.block and PrePrepare.block_digest(pp.block) != pp.digest:
         return None
     items: List[BatchItem] = []
     it = _sig_item(cfg, pp)
@@ -204,15 +207,17 @@ def validate_view_change(
 
 def compute_o_set(
     cfg, vcs: Dict[str, ViewChange], new_view: int
-) -> Tuple[int, List[Tuple[int, str, List[Dict[str, Any]]]]]:
+) -> Tuple[int, List[Tuple[int, str]]]:
     """Deterministic O-set from a view-change certificate: returns
-    (h, [(seq, digest, block), ...]) for seq in (h, max_s], highest-view
-    prepared certificate winning, no-op blocks for gaps.
+    (h, [(seq, digest), ...]) for seq in (h, max_s], highest-view
+    prepared certificate winning, the no-op digest for gaps. Blocks are
+    NOT part of O — certificates are digest-only; receivers refill
+    blocks from their store or BlockFetch at install.
 
     Callers pass only structurally-validated, signature-verified VCs.
     """
     h = max((vc.stable_seq for vc in vcs.values()), default=0)
-    best: Dict[int, Tuple[int, str, List[Dict[str, Any]]]] = {}
+    best: Dict[int, Tuple[int, str]] = {}
     for vc in vcs.values():
         for proof in vc.prepared_proofs:
             pp = _decode(proof.get("pre_prepare"), PrePrepare)
@@ -220,15 +225,14 @@ def compute_o_set(
                 continue
             cur = best.get(pp.seq)
             if cur is None or pp.view > cur[0]:
-                best[pp.seq] = (pp.view, pp.digest, pp.block)
+                best[pp.seq] = (pp.view, pp.digest)
     max_s = max(best, default=h)
     out = []
     for seq in range(h + 1, max_s + 1):
         if seq in best:
-            _, digest, block = best[seq]
-            out.append((seq, digest, block))
+            out.append((seq, best[seq][1]))
         else:
-            out.append((seq, PrePrepare.block_digest(NOOP_BLOCK), NOOP_BLOCK))
+            out.append((seq, EMPTY_BLOCK_DIGEST))
     return h, out
 
 
@@ -262,31 +266,27 @@ def validate_new_view(
         vcs[vc.sender] = vc
     if len(vcs) < cfg.quorum:
         return None
-    # O must be exactly the deterministic function of V
+    # O must be exactly the deterministic function of V (digest-only;
+    # re-issued pre-prepares ship detached — blocks resolve at install,
+    # where the digest check makes substitution impossible. Client
+    # signatures inside blocks were verified at original admission, and
+    # every O-set digest is backed by a prepared certificate from at
+    # least f+1 honest replicas that performed that check.)
     _, o_set = compute_o_set(cfg, vcs, msg.new_view)
     if not isinstance(msg.pre_prepares, list) or len(msg.pre_prepares) != len(o_set):
         return None
-    for rd, (seq, digest, block) in zip(msg.pre_prepares, o_set):
+    for rd, (seq, digest) in zip(msg.pre_prepares, o_set):
         pp = _decode(rd, PrePrepare)
         if pp is None:
             return None
         if (pp.view, pp.seq, pp.digest) != (msg.new_view, seq, digest):
             return None
-        if pp.block != block or pp.sender != msg.sender:
-            return None
+        if pp.block or pp.sender != msg.sender:
+            return None  # re-issues are always detached
         it = _sig_item(cfg, pp)
         if it is None:
             return None
         items.append(it)
-        # client signatures inside re-issued blocks verify too
-        for rdreq in pp.block:
-            req = _decode(rdreq, Request)
-            if req is None or req.sender != req.client_id:
-                return None
-            it = _sig_item(cfg, req)
-            if it is None:
-                return None
-            items.append(it)
     return vcs, items, qcs
 
 
@@ -489,8 +489,11 @@ class ViewChanger:
         vcs = dict(list(self.vc_store[new_view].items())[: r.cfg.quorum])
         h, o_set = compute_o_set(r.cfg, vcs, new_view)
         pre_prepares = []
-        for seq, digest, block in o_set:
-            pp = PrePrepare(view=new_view, seq=seq, digest=digest, block=block)
+        for seq, digest in o_set:
+            # detached: the signature covers the digest; every receiver
+            # (including this primary, at install) refills the block from
+            # its store or fetches it
+            pp = PrePrepare(view=new_view, seq=seq, digest=digest, block=[])
             r.signer.sign_msg(pp)
             pre_prepares.append(pp.to_dict())
         nv = NewView(
@@ -568,21 +571,36 @@ class ViewChanger:
         # on_qc only records failures for the CURRENT view, so every key
         # is from a view < new_view — clear the lot
         r._qc_bad_by_sender.clear()
+        # likewise block fetches buffered under dead views: this install
+        # re-buffers what its own O-set still needs; stale entries would
+        # hold has_outstanding_work() true forever
+        r.prune_stale_block_pending(new_view)
 
         max_seq = r.stable_seq
+        missing: List[str] = []
         for rd in nv.pre_prepares:
             pp = _decode(rd, PrePrepare)
             if pp is None:  # validated already; defensive
                 continue
             max_seq = max(max_seq, pp.seq)
-            if pp.seq > r.stable_seq + r.cfg.watermark_window:
+            # resolve the detached block: no-op digests fill trivially,
+            # known digests fill from the store, unknown ones go through
+            # the fetch protocol (replica delivers on BlockReply)
+            filled = r.resolve_block(pp)
+            if filled is None:
+                missing.append(pp.digest)
+                r.buffer_for_block(pp)
+                continue
+            if filled.seq > r.stable_seq + r.cfg.watermark_window:
                 # local watermark lags the certificate's h (state transfer
                 # pending): _on_phase would silently drop this seq and we'd
                 # never participate in the slot. Buffer; the replica
                 # replays once _advance_stable catches up.
-                r.vc_replay[pp.seq] = pp
+                r.vc_replay[filled.seq] = filled
             else:
-                await r.on_phase_msg(pp)
+                await r.on_phase_msg(filled)
+        if missing:
+            await r.request_blocks(missing)
         if r.cfg.primary(new_view) == r.id:
             r.next_seq = max_seq + 1
             r.adopt_relayed_requests()

@@ -162,8 +162,10 @@ class Message:
             if (
                 b'"kind": "viewchange"' not in raw
                 and b'"kind": "newview"' not in raw
+                and b'"kind": "blockreply"' not in raw
                 and b'"kind":"viewchange"' not in raw
                 and b'"kind":"newview"' not in raw
+                and b'"kind":"blockreply"' not in raw
             ):
                 raise ValueError("message too large for its type")
         try:
@@ -253,6 +255,20 @@ class PrePrepare(Message):
     seq: int = 0
     digest: str = ""
     block: List[Dict[str, Any]] = field(default_factory=list)
+
+    def signing_payload(self) -> bytes:
+        """Sign over (view, seq, digest) with the block DETACHED — the
+        digest binds the block content (block_digest is enforced at every
+        admission point: state.Instance.on_pre_prepare, the view-change
+        validators, and the block-fetch fill path). Castro-Liskov §2.4
+        does the same ("the big message is not included"): it lets
+        view-change certificates ship digest-only pre-prepares and lets
+        replicas refill blocks from their store or a fetch without
+        breaking the primary's signature."""
+        d = self.to_dict()
+        d["sig"] = ""
+        d["block"] = []
+        return canonical_json(d)
 
     @staticmethod
     def block_digest(block: List[Dict[str, Any]]) -> str:
@@ -391,5 +407,35 @@ class StateResponse(Message):
     seq: int = 0
     snapshot: str = ""
 
+
+@dataclass
+class BlockFetch(Message):
+    """Ask peers for blocks by digest — view-change certificates ship
+    digest-only pre-prepares (see PrePrepare.signing_payload), so a
+    replica installing a NEW-VIEW may lack the block behind a re-issued
+    digest. Any replica that stored the block answers."""
+
+    KIND: ClassVar[str] = "blockfetch"
+
+    digests: List[str] = field(default_factory=list)
+
+
+@dataclass
+class BlockReply(Message):
+    """Blocks for a BlockFetch: entries of {"digest": ..., "block": [...]}.
+    Self-authenticating — the receiver recomputes block_digest(block) and
+    drops mismatches, so the responder need not be trusted. Carries full
+    request blocks, so it shares the certificate-class wire cap (and
+    responders chunk replies well below it — replica._on_block_fetch)."""
+
+    KIND: ClassVar[str] = "blockreply"
+    MAX_WIRE_BYTES: ClassVar[int] = 64 * 1024 * 1024
+
+    blocks: List[Dict[str, Any]] = field(default_factory=list)
+
+
+# The digest of the empty (no-op) block: O-set gap slots and detached
+# pre-prepare resolution both compare against it on hot paths.
+EMPTY_BLOCK_DIGEST = PrePrepare.block_digest([])
 
 ALL_KINDS = tuple(sorted(_REGISTRY))

@@ -196,9 +196,10 @@ def test_o_set_prefers_highest_view_and_fills_gaps():
     }
     h, o_set = compute_o_set(cfg, vcs, new_view=2)
     assert h == 0
-    assert [seq for seq, _, _ in o_set] == [1, 2]
-    # seq 1 is a gap -> no-op block; seq 2 takes the view-1 certificate
-    assert o_set[0][2] == []
+    assert [seq for seq, _ in o_set] == [1, 2]
+    # seq 1 is a gap -> no-op digest; seq 2 takes the view-1 certificate
+    # (O is digest-only: blocks resolve at install from store/fetch)
+    assert o_set[0][1] == PrePrepare.block_digest([])
     assert o_set[1][1] == pp1.digest
 
 
@@ -249,10 +250,11 @@ def test_validate_new_view_rejects_tampered_o_set():
     ]
     new_primary = cfg.primary(1)
 
-    def build_nv(blocks):
+    def build_nv(slots):
         pps = []
-        for seq, digest, block in blocks:
-            npp = PrePrepare(view=1, seq=seq, digest=digest, block=block)
+        for seq, digest in slots:
+            # re-issues are always detached (digest-only)
+            npp = PrePrepare(view=1, seq=seq, digest=digest, block=[])
             Signer(new_primary, keys[new_primary].seed).sign_msg(npp)
             pps.append(npp.to_dict())
         nv = NewView(
@@ -267,7 +269,7 @@ def test_validate_new_view_rejects_tampered_o_set():
     assert validate_new_view(cfg, build_nv(o_set)) is not None
 
     # drop the prepared slot (primary trying to lose a prepared request)
-    empty = [(1, PrePrepare.block_digest([]), [])]
+    empty = [(1, PrePrepare.block_digest([]))]
     assert validate_new_view(cfg, build_nv(empty)) is None
 
     # wrong sender: only the new view's primary may install it
@@ -378,5 +380,56 @@ def test_vc_replay_buffer_feeds_window_laggards():
         assert 7 not in r.vc_replay
         inst = r.instances.get((0, 7))
         assert inst is not None and inst.pre_prepare is not None
+
+    _run(main())
+
+
+def test_detached_newview_block_fetched_by_laggard():
+    """Digest-only failover end to end: a backup that never saw the
+    original pre-prepare (no block behind the re-issued digest) must
+    FETCH the block from peers after the NEW-VIEW and install the slot
+    with the exact original content."""
+
+    async def main():
+        c = LocalCommittee.build(n=4, view_timeout=0)  # timers off
+        c.start()
+        try:
+            proof, pp = _prepared_proof(c.cfg, c.keys, view=0, seq=1,
+                                        op="put fetched 1")
+            original_block = pp.block
+            # r1 and r2 admit the original pre-prepare (block lands in
+            # their stores); r3 never sees it
+            from simple_pbft_tpu.messages import Message
+
+            for rid in ("r1", "r2"):
+                r = c.replica(rid)
+                await r.on_phase_msg(pp)
+                assert pp.digest in r.block_store
+            # r1 holds a full prepared certificate for the slot
+            r1 = c.replica("r1")
+            for rd in proof["prepares"]:
+                await r1.on_phase_msg(Message.from_dict(rd))
+
+            # the new view's primary (r1) collects 2f+1 VIEW-CHANGEs:
+            # its own (carries the digest-only prepared proof) + r2 + r3
+            await r1.vc.start_view_change(1)
+            assert c.cfg.primary(1) == "r1"
+            for rid in ("r2", "r3"):
+                await r1.vc.on_view_change(
+                    _signed_vc(c.cfg, c.keys, rid, 1)
+                )
+            # NEW-VIEW broadcast -> r3 installs, lacks the block, fetches
+            r3 = c.replica("r3")
+            for _ in range(100):
+                if r3.metrics.get("blocks_fetched", 0) >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert r3.metrics.get("blocks_fetched", 0) >= 1, dict(r3.metrics)
+            inst = r3.instances.get((1, 1))
+            assert inst is not None and inst.pre_prepare is not None
+            assert inst.pre_prepare.block == original_block
+            assert inst.pre_prepare.digest == pp.digest
+        finally:
+            await c.stop()
 
     _run(main())
