@@ -22,7 +22,9 @@ from typing import Dict, List, Optional
 from ..crypto import bls
 from ..messages import QuorumCert, qc_payload
 
-PHASES = ("prepare", "commit")
+# "checkpoint" certs attest state digests (view pinned to 0 in the
+# payload — checkpoints are view-independent); see replica._on_checkpoint
+PHASES = ("prepare", "commit", "checkpoint")
 
 _CACHE_MAX = 4096
 _cache: "OrderedDict[tuple, bool]" = OrderedDict()

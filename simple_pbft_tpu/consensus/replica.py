@@ -124,6 +124,9 @@ class Replica:
         # (messages.PrePrepare.signing_payload), so installs refill from
         # here; GC'd against the stable watermark via the seq binding
         self.block_store: Dict[str, Tuple[int, List[Dict[str, Any]]]] = {}
+        # QC mode: lazily-built aggregate checkpoint certificates, by seq
+        # (built on first view-change need, not per stabilization)
+        self.checkpoint_qcs: Dict[int, QuorumCert] = {}
         # detached re-issues awaiting a BlockReply, by digest (bounded)
         self.block_pending: Dict[str, PrePrepare] = {}
         self.vc = ViewChanger(self)
@@ -589,6 +592,32 @@ class Replica:
     # QC mode: primary-side aggregation + certificate handling
     # ------------------------------------------------------------------
 
+    async def _aggregate_verified(
+        self, phase: str, view: int, seq: int, digest: str, shares: Dict[str, str]
+    ) -> Tuple[Optional[QuorumCert], set]:
+        """Shared aggregate pipeline: build, pairing self-check off-loop,
+        bisect out Byzantine shares on failure, rebuild, re-verify.
+        Returns (verified cert or None, senders whose shares were bad)."""
+        cert = qc_mod.build_qc(phase, view, seq, digest, shares, self.cfg.quorum)
+        if cert is None:
+            return None, set()
+        if await asyncio.to_thread(qc_mod.verify_qc, self.cfg, cert):
+            return cert, set()
+        self.metrics["qc_aggregate_failed"] += 1
+        good = await asyncio.to_thread(
+            qc_mod.bisect_bad_shares, self.cfg, phase, view, seq, digest, shares
+        )
+        bad = set(shares) - set(good)
+        self.metrics["qc_bad_shares"] += len(bad)
+        if len(good) < self.cfg.quorum:
+            return None, bad
+        cert = qc_mod.build_qc(phase, view, seq, digest, good, self.cfg.quorum)
+        if cert is None or not await asyncio.to_thread(
+            qc_mod.verify_qc, self.cfg, cert
+        ):
+            return None, bad
+        return cert, bad
+
     async def _try_aggregate(self, inst: Instance, phase: str) -> None:
         """Primary only: once 2f+1 matching shares are logged for a phase,
         aggregate them into a QuorumCert, self-check its pairing (one
@@ -607,29 +636,13 @@ class Replica:
         }
         if len(shares) < self.cfg.quorum:
             return
-        cert = qc_mod.build_qc(
-            phase, inst.view, inst.seq, inst.digest, shares, self.cfg.quorum
+        cert, bad = await self._aggregate_verified(
+            phase, inst.view, inst.seq, inst.digest, shares
         )
+        for sender in bad:
+            log_map.pop(sender, None)
         if cert is None:
             return
-        if not await asyncio.to_thread(qc_mod.verify_qc, self.cfg, cert):
-            self.metrics["qc_aggregate_failed"] += 1
-            good = await asyncio.to_thread(
-                qc_mod.bisect_bad_shares,
-                self.cfg, phase, inst.view, inst.seq, inst.digest, shares,
-            )
-            for sender in set(shares) - set(good):
-                log_map.pop(sender, None)
-                self.metrics["qc_bad_shares"] += 1
-            if len(good) < self.cfg.quorum:
-                return
-            cert = qc_mod.build_qc(
-                phase, inst.view, inst.seq, inst.digest, good, self.cfg.quorum
-            )
-            if cert is None or not await asyncio.to_thread(
-                qc_mod.verify_qc, self.cfg, cert
-            ):
-                return
         self._qc_sent.add(key)
         self.signer.sign_msg(cert)
         self.metrics["qcs_formed"] += 1
@@ -641,6 +654,12 @@ class Replica:
         it is self-certifying). One pairing check (memoized) then drive
         the instance's QC transitions."""
         if not self.cfg.qc_mode:
+            self.metrics["unroutable"] += 1
+            return
+        if msg.phase not in ("prepare", "commit"):
+            # checkpoint aggregates only travel inside view-change
+            # certificates; a standalone one routed here would otherwise
+            # be treated as a vote QC over a STATE digest
             self.metrics["unroutable"] += 1
             return
         if self.vc.in_view_change and msg.phase != "commit":
@@ -800,9 +819,52 @@ class Replica:
         self.checkpoint_digests[seq] = digest
         self.snapshots[seq] = snap
         cp = Checkpoint(seq=seq, state_digest=digest)
+        if self.cfg.qc_mode and self.bls_sk is not None:
+            # share for the aggregate checkpoint certificate (view pinned
+            # to 0: checkpoints are view-independent)
+            cp.bls_share = qc_mod.sign_share(
+                self.bls_sk, "checkpoint", 0, seq, digest
+            )
         self.signer.sign_msg(cp)
         await self._on_checkpoint(cp)  # count our own
         await self.transport.broadcast(cp.to_wire(), self.cfg.replica_ids)
+
+    async def ensure_checkpoint_qc(self) -> None:
+        """QC mode: aggregate the stored 2f+1 checkpoint shares at the
+        stable watermark into ONE CheckpointQC for view-change proofs.
+        Lazy — runs when a failover actually needs it, not per
+        stabilization — and self-checks the aggregate (bisecting out
+        Byzantine shares) exactly like the vote path."""
+        if not self.cfg.qc_mode or self.stable_seq == 0:
+            return
+        seq = self.stable_seq
+        if seq in self.checkpoint_qcs:
+            return
+        votes = self.checkpoints.get(seq, {})
+        digest = self.checkpoint_digests.get(seq)
+        if digest is None:
+            return
+        shares = {
+            sender: cp.bls_share
+            for sender, cp in votes.items()
+            if cp.state_digest == digest
+            and cp.bls_share
+            and qc_mod.share_valid_shape(cp.bls_share)
+        }
+        if len(shares) < self.cfg.quorum:
+            return
+        cert, _bad = await self._aggregate_verified(
+            "checkpoint", 0, seq, digest, shares
+        )
+        if cert is None:
+            return
+        # the awaited pairings yield the event loop: the watermark may
+        # have advanced meanwhile, making this aggregate dead on arrival
+        # (and already outside _advance_stable's GC)
+        if seq < self.stable_seq:
+            return
+        self.signer.sign_msg(cert)
+        self.checkpoint_qcs[seq] = cert
 
     async def _on_checkpoint(self, msg: Checkpoint) -> None:
         if msg.seq <= self.stable_seq:
@@ -822,25 +884,31 @@ class Replica:
         view-change certificates (state catch-up across views)."""
         await self._on_checkpoint(msg)
 
-    async def _stabilize(self, seq: int, digest: str) -> None:
+    async def _stabilize(
+        self, seq: int, digest: str, certifiers: Optional[List[str]] = None
+    ) -> None:
         """A checkpoint certificate formed at ``seq``. If we have executed
         that far ourselves, just advance the watermark; otherwise we are
         lagging (missed commits the rest of the committee GC'd) and must
-        state-transfer before adopting it."""
+        state-transfer before adopting it. ``certifiers`` names replicas
+        known to hold the state (a CheckpointQC's signer set — the local
+        vote map is EMPTY when stabilization came from an aggregate)."""
         if seq <= self.stable_seq:
             return
         if seq > self.executed_seq:
             if self.pending_sync is None or self.pending_sync[0] < seq:
                 self.pending_sync = (seq, digest)
                 self.metrics["state_sync_requests"] += 1
-                certifiers = [
-                    r
-                    for r, cp in self.checkpoints[seq].items()
-                    if cp.state_digest == digest and r != self.id
-                ]
+                if certifiers is None:
+                    certifiers = [
+                        r
+                        for r, cp in self.checkpoints[seq].items()
+                        if cp.state_digest == digest
+                    ]
+                targets = [r for r in certifiers if r != self.id]
                 sr = StateRequest(seq=seq)
                 self.signer.sign_msg(sr)
-                for peer in certifiers[: self.cfg.f + 1]:
+                for peer in targets[: self.cfg.f + 1]:
                     await self.transport.send(peer, sr.to_wire())
             return
         self._advance_stable(seq)
@@ -1047,6 +1115,11 @@ class Replica:
         }
         self.block_pending = {
             dg: pp for dg, pp in self.block_pending.items() if pp.seq > seq
+        }
+        # keep the aggregate AT the new watermark (the next VIEW-CHANGE
+        # proves exactly this h); older ones are dead
+        self.checkpoint_qcs = {
+            s: c for s, c in self.checkpoint_qcs.items() if s >= seq
         }
         self._qc_sent = {k for k in self._qc_sent if k[1] > seq}
         self.seen_requests = {

@@ -162,34 +162,53 @@ def validate_view_change(
     if msg.stable_seq < 0:
         return None
     items: List[BatchItem] = []
+    qcs: List[QuorumCert] = []
     # checkpoint certificate for h (h = 0 needs no proof: genesis)
     cps: List[Checkpoint] = []
     if msg.stable_seq > 0:
         if not isinstance(msg.checkpoint_proof, list) or len(msg.checkpoint_proof) > cfg.n:
             return None
-        senders = set()
-        digests = set()
-        for rd in msg.checkpoint_proof:
-            cp = _decode(rd, Checkpoint)
-            if cp is None or cp.seq != msg.stable_seq:
+        cp_qc = (
+            _decode(msg.checkpoint_proof[0], QuorumCert)
+            if cfg.qc_mode and len(msg.checkpoint_proof) == 1
+            else None
+        )
+        if cp_qc is not None:
+            # QC form: one aggregate over ("checkpoint", 0, h, digest)
+            if cp_qc.phase != "checkpoint" or cp_qc.seq != msg.stable_seq:
                 return None
-            if cp.sender in senders or cp.sender not in cfg.replica_ids:
+            if cp_qc.view != 0:
                 return None
-            senders.add(cp.sender)
-            digests.add(cp.state_digest)
-            it = _sig_item(cfg, cp)
-            if it is None:
+            if len(cp_qc.signers) < cfg.quorum or len(set(cp_qc.signers)) != len(
+                cp_qc.signers
+            ):
                 return None
-            items.append(it)
-            cps.append(cp)
-        if len(cps) < cfg.quorum or len(digests) != 1:
-            return None
+            if any(s not in cfg.replica_ids for s in cp_qc.signers):
+                return None
+            qcs.append(cp_qc)  # pairing check runs with the other certs
+        else:
+            senders = set()
+            digests = set()
+            for rd in msg.checkpoint_proof:
+                cp = _decode(rd, Checkpoint)
+                if cp is None or cp.seq != msg.stable_seq:
+                    return None
+                if cp.sender in senders or cp.sender not in cfg.replica_ids:
+                    return None
+                senders.add(cp.sender)
+                digests.add(cp.state_digest)
+                it = _sig_item(cfg, cp)
+                if it is None:
+                    return None
+                items.append(it)
+                cps.append(cp)
+            if len(cps) < cfg.quorum or len(digests) != 1:
+                return None
     if not isinstance(msg.prepared_proofs, list):
         return None
     if len(msg.prepared_proofs) > cfg.watermark_window:
         return None
     prepared: Dict[int, Tuple[PrePrepare, List[Prepare]]] = {}
-    qcs: List[QuorumCert] = []
     for proof in msg.prepared_proofs:
         res = validate_prepared_proof(
             cfg, proof, msg.stable_seq, msg.stable_seq + cfg.watermark_window
@@ -374,6 +393,7 @@ class ViewChanger:
             self.cancel()
             self._timer = loop.call_later(self._timeout, self._expired)
 
+        await self.r.ensure_checkpoint_qc()  # QC mode: one aggregate for h
         vc = self.build_view_change(new_view)
         self.r.signer.sign_msg(vc)
         wire = vc.to_wire()
@@ -402,8 +422,25 @@ class ViewChanger:
         r = self.r
         cp_proof = []
         if r.stable_seq > 0:
-            cert = r.checkpoints.get(r.stable_seq, {})
-            cp_proof = [cp.to_dict() for cp in cert.values()][: r.cfg.n]
+            qc = r.checkpoint_qcs.get(r.stable_seq)
+            if qc is not None:
+                # QC mode: ONE aggregate proves h (vs 2f+1 signed msgs)
+                cp_proof = [qc.to_dict()]
+            else:
+                # ship only votes for the digest that actually stabilized:
+                # one Byzantine checkpoint with a divergent digest in the
+                # stored map would otherwise make validate_view_change
+                # (len(digests) != 1) reject the whole VIEW-CHANGE
+                votes = r.checkpoints.get(r.stable_seq, {})
+                counts: Dict[str, int] = {}
+                for cp in votes.values():
+                    counts[cp.state_digest] = counts.get(cp.state_digest, 0) + 1
+                stable_digest = max(counts, key=counts.get, default=None)
+                cp_proof = [
+                    cp.to_dict()
+                    for cp in votes.values()
+                    if cp.state_digest == stable_digest
+                ][: r.cfg.n]
         # Castro-Liskov P-set: ONE certificate per seq — the highest-view
         # one. A seq prepared in two successive views (prepared in v,
         # re-prepared via the O-set in v+1, not committed) must not emit
@@ -460,9 +497,17 @@ class ViewChanger:
         store = self.vc_store.setdefault(msg.new_view, {})
         store[msg.sender] = msg
         # adopt the highest checkpoint the committee proves (state catch-up)
-        _, cps, _, _ = res
+        _, cps, _, vqcs = res
         for cp in cps:
             await r.on_checkpoint_msg(cp)
+        for cert in vqcs:
+            # checkpoint aggregates were pairing-verified above: adopt for
+            # our OWN future VIEW-CHANGEs (we may never see the individual
+            # checkpoint votes) and stabilize, fetching state from the
+            # aggregate's signers
+            if cert.phase == "checkpoint":
+                r.checkpoint_qcs.setdefault(cert.seq, cert)
+                await r._stabilize(cert.seq, cert.digest, list(cert.signers))
 
         # liveness: f+1 replicas moving past us -> join the lowest such view
         if not self.in_view_change or msg.new_view > self.target_view:
@@ -541,7 +586,7 @@ class ViewChanger:
         if not await self._verify_qcs(res[2]):
             r.metrics["bad_newview_qc"] += 1
             return
-        vcs, _, _ = res
+        vcs, _, nvqcs = res
         h, o_set = compute_o_set(r.cfg, vcs, msg.new_view)
         # catch up on checkpoints the certificate proves
         for vc in vcs.values():
@@ -549,6 +594,11 @@ class ViewChanger:
                 cp = _decode(rd, Checkpoint)
                 if cp is not None:
                     await r.on_checkpoint_msg(cp)
+        for cert in nvqcs:
+            # nested checkpoint aggregates (pairing-verified above)
+            if cert.phase == "checkpoint":
+                r.checkpoint_qcs.setdefault(cert.seq, cert)
+                await r._stabilize(cert.seq, cert.digest, list(cert.signers))
         await self.install(msg.new_view, msg)
 
     async def install(self, new_view: int, nv: NewView) -> None:

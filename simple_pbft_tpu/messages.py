@@ -345,12 +345,19 @@ class QuorumCert(Message):
 
 @dataclass
 class Checkpoint(Message):
-    """Periodic proof of execution state at a sequence number."""
+    """Periodic proof of execution state at a sequence number.
+
+    In QC mode ``bls_share`` (hex G1 signature over
+    ``qc_payload("checkpoint", 0, seq, state_digest)``) lets any replica
+    aggregate the 2f+1 matching checkpoints it collects into ONE
+    CheckpointQC — so a VIEW-CHANGE's proof of h is a single aggregate
+    instead of 2f+1 signed messages."""
 
     KIND: ClassVar[str] = "checkpoint"
 
     seq: int = 0
     state_digest: str = ""
+    bls_share: str = ""
 
 
 @dataclass

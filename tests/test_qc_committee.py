@@ -138,3 +138,53 @@ def test_qc_large_committee_single_aggregate_check():
         assert committed >= com.cfg.quorum
 
     run(scenario(), timeout=600)
+
+
+def test_qc_checkpoint_aggregate_in_viewchange():
+    """QC-mode failover after a stable checkpoint: the VIEW-CHANGE must
+    prove h with ONE CheckpointQC aggregate instead of 2f+1 signed
+    checkpoint messages, and peers must accept it (failover completes,
+    state survives)."""
+
+    async def _eventually(pred, timeout=10.0):
+        deadline = asyncio.get_running_loop().time() + timeout
+        while asyncio.get_running_loop().time() < deadline:
+            if pred():
+                return True
+            await asyncio.sleep(0.05)
+        return False
+
+    async def scenario():
+        com = LocalCommittee.build(
+            n=4, clients=1, qc_mode=True, view_timeout=4.0,
+            checkpoint_interval=2,
+        )
+        com.clients[0].request_timeout = 8.0
+        com.start()
+        try:
+            for i in range(4):  # past two checkpoint intervals
+                assert await com.clients[0].submit(f"put k{i} {i}") == "ok"
+            # submit returns on f+1 replies; poll for committee-wide state
+            assert await _eventually(
+                lambda: all(r.stable_seq > 0 for r in com.replicas)
+            )
+            com.replica("r0").kill()
+            assert await com.clients[0].submit("put after 1", retries=20) == "ok"
+            survivors = [r for r in com.replicas if r.id != "r0"]
+            assert all(r.view >= 1 for r in survivors)
+            assert await _eventually(
+                lambda: all(r.app.data.get("after") == "1" for r in survivors)
+            )
+            # at least one survivor built the aggregate and shipped a
+            # one-entry checkpoint proof in its VIEW-CHANGE
+            assert any(r.checkpoint_qcs for r in survivors), [
+                dict(r.checkpoint_qcs) for r in survivors
+            ]
+            qc = next(
+                c for r in survivors for c in r.checkpoint_qcs.values()
+            )
+            assert qc.phase == "checkpoint" and len(qc.signers) >= com.cfg.quorum
+        finally:
+            await com.stop()
+
+    run(scenario())
