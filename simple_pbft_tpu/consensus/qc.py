@@ -22,9 +22,12 @@ from typing import Dict, List, Optional
 from ..crypto import bls
 from ..messages import QuorumCert, qc_payload
 
-# "checkpoint" certs attest state digests (view pinned to 0 in the
-# payload — checkpoints are view-independent); see replica._on_checkpoint
-PHASES = ("prepare", "commit", "checkpoint")
+# Vote QCs drive instance transitions; "checkpoint" certs attest state
+# digests (view pinned to 0 in the payload — checkpoints are
+# view-independent) and travel ONLY inside view-change certificates.
+# Routing guards use VOTE_PHASES so the two sets cannot drift.
+VOTE_PHASES = ("prepare", "commit")
+PHASES = VOTE_PHASES + ("checkpoint",)
 
 _CACHE_MAX = 4096
 _cache: "OrderedDict[tuple, bool]" = OrderedDict()

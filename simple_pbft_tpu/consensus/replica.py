@@ -656,7 +656,7 @@ class Replica:
         if not self.cfg.qc_mode:
             self.metrics["unroutable"] += 1
             return
-        if msg.phase not in ("prepare", "commit"):
+        if msg.phase not in qc_mod.VOTE_PHASES:
             # checkpoint aggregates only travel inside view-change
             # certificates; a standalone one routed here would otherwise
             # be treated as a vote QC over a STATE digest
@@ -800,10 +800,15 @@ class Replica:
             {
                 "app": self.app.snapshot(),
                 "watermark": self.client_watermark,
-                # replies canonicalized: sender/sig blanked so every
-                # replica's snapshot digest agrees (each re-signs on resend)
+                # replies canonicalized: sender/sig blanked (each replica
+                # re-signs on resend) AND view blanked — replicas execute
+                # the same request in DIFFERENT views around a failover,
+                # and a view-bearing digest would keep 2f+1 checkpoint
+                # digests from ever matching during view-change storms
+                # (found by the fault-injection soak: identical app state,
+                # diverged checkpoint digests, stalled stabilization)
                 "replies": {
-                    c: {**r.to_dict(), "sender": "", "sig": ""}
+                    c: {**r.to_dict(), "sender": "", "sig": "", "view": 0}
                     for c, r in sorted(self.last_reply.items())
                 },
             },
@@ -853,9 +858,13 @@ class Replica:
         }
         if len(shares) < self.cfg.quorum:
             return
-        cert, _bad = await self._aggregate_verified(
+        cert, bad = await self._aggregate_verified(
             "checkpoint", 0, seq, digest, shares
         )
+        for sender in bad:
+            # drop Byzantine shares so the (un-memoized) bisection does
+            # not repeat on every subsequent view-change attempt
+            self.checkpoints.get(seq, {}).pop(sender, None)
         if cert is None:
             return
         # the awaited pairings yield the event loop: the watermark may
