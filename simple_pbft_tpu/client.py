@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import time
 from collections import defaultdict
 from typing import Dict, Optional
 
@@ -41,7 +42,16 @@ class Client:
         self.transport = transport
         self.verifier = verifier if verifier is not None else best_cpu_verifier()
         self.request_timeout = request_timeout
-        self._ts = itertools.count(1)
+        # microsecond wall-clock start (Castro-Liskov §2.4: client
+        # timestamps are monotonic ACROSS restarts — a counter from 1
+        # would leave a restarted client below the replicas' per-client
+        # dedup watermark, every request silently dropped as a replay;
+        # found by the real-process failover test). Known limitation,
+        # shared with every clock-derived request-id scheme: a host clock
+        # stepped BACKWARDS across a restart re-enters the replay window
+        # until wall-clock passes the old watermark; deploy clients with
+        # slewing (not stepping) time sync, or persist the last timestamp.
+        self._ts = itertools.count(int(time.time() * 1_000_000))
         self._waiters: Dict[int, asyncio.Future] = {}
         self._replies: Dict[int, Dict[str, str]] = defaultdict(dict)
         self._task: Optional[asyncio.Task] = None

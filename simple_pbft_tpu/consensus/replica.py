@@ -97,8 +97,15 @@ class Replica:
         self.ready: Dict[int, ExecuteBlock] = {}  # committed, awaiting order
         self.pending_requests: List[Request] = []  # primary's backlog
         self.seen_requests: Dict[Tuple[str, int], int] = {}  # dedup -> seq
-        self.client_watermark: Dict[str, int] = {}  # client -> max exec'd ts
-        self.last_reply: Dict[str, Reply] = {}  # client -> latest reply
+        # Per-client replay protection for PIPELINED clients. A client's
+        # concurrent requests can commit out of timestamp order (relays
+        # scramble arrival during failover), so a max-executed-ts
+        # watermark alone would skip lower timestamps forever. Instead:
+        # `client_watermark` is the FLOOR (everything at/below executed,
+        # folded forward at checkpoints) and `recent_replies` holds the
+        # exact executed timestamps (with their replies) above it.
+        self.client_watermark: Dict[str, int] = {}
+        self.recent_replies: Dict[str, Dict[int, Reply]] = {}
         self.committed_log: List[Tuple[int, str]] = []  # (seq, digest) > h
         # seq -> sender -> signed Checkpoint message (kept, not just the
         # digest: view-change certificates re-ship these as proof of h)
@@ -486,12 +493,16 @@ class Replica:
 
     async def _on_request(self, req: Request) -> None:
         key = (req.client_id, req.timestamp)
-        executed_ts = self.client_watermark.get(req.client_id, 0)
-        if req.timestamp <= executed_ts or key in self.seen_requests:
-            # duplicate: re-send the cached reply if we already executed it;
-            # anything at/below the client's executed watermark is a replay
-            cached = self.last_reply.get(req.client_id)
-            if cached is not None and cached.timestamp == req.timestamp:
+        floor = self.client_watermark.get(req.client_id, 0)
+        recent = self.recent_replies.get(req.client_id, {})
+        if (
+            req.timestamp <= floor
+            or req.timestamp in recent
+            or key in self.seen_requests
+        ):
+            # duplicate: re-send the cached reply if we already executed it
+            cached = recent.get(req.timestamp)
+            if cached is not None:
                 await self.transport.send(req.client_id, cached.to_wire())
             elif key in self.relay_buffer or key in self.seen_requests:
                 # client is retrying something still unexecuted: the
@@ -762,15 +773,19 @@ class Replica:
                 continue
             for req in reqs:
                 self.relay_buffer.pop((req.client_id, req.timestamp), None)
-                if req.timestamp <= self.client_watermark.get(
-                    req.client_id, 0
+                recent = self.recent_replies.get(req.client_id, {})
+                if (
+                    req.timestamp <= self.client_watermark.get(req.client_id, 0)
+                    or req.timestamp in recent
                 ):
-                    # replayed request that slipped into a block: no-op
+                    # EXACT-ts replay that slipped into a block: no-op.
+                    # (A max-ts watermark here would skip lower timestamps
+                    # of a pipelined client whose requests committed out
+                    # of order after a failover — deadlocking the client.)
                     self.metrics["exec_replay_skipped"] += 1
                     continue
                 result = self.app.apply(req.operation)
                 self.metrics["committed_requests"] += 1
-                self.client_watermark[req.client_id] = req.timestamp
                 reply = Reply(
                     view=act.view,
                     seq=act.seq,
@@ -779,7 +794,9 @@ class Replica:
                     result=result,
                 )
                 self.signer.sign_msg(reply)
-                self.last_reply[req.client_id] = reply
+                self.recent_replies.setdefault(req.client_id, {})[
+                    req.timestamp
+                ] = reply
                 await self.transport.send(req.client_id, reply.to_wire())
             if self.executed_seq % self.cfg.checkpoint_interval == 0:
                 await self._emit_checkpoint(self.executed_seq)
@@ -808,8 +825,14 @@ class Replica:
                 # (found by the fault-injection soak: identical app state,
                 # diverged checkpoint digests, stalled stabilization)
                 "replies": {
-                    c: {**r.to_dict(), "sender": "", "sig": "", "view": 0}
-                    for c, r in sorted(self.last_reply.items())
+                    c: {
+                        str(ts): {
+                            **r.to_dict(), "sender": "", "sig": "", "view": 0,
+                        }
+                        for ts, r in sorted(recent.items())
+                    }
+                    for c, recent in sorted(self.recent_replies.items())
+                    if recent
                 },
             },
             sort_keys=True,
@@ -819,6 +842,29 @@ class Replica:
     async def _emit_checkpoint(self, seq: int) -> None:
         from ..app import snapshot_digest
 
+        # Fold the per-client replay state forward — but only entries
+        # executed at least one FULL checkpoint interval ago (reply.seq
+        # records the executing seq, so the fold is a deterministic
+        # function of executed history and every replica folds
+        # identically). Folding everything to max(ts) would reintroduce
+        # the pipelined-client deadlock at checkpoint granularity: a
+        # lower-ts request still in flight when the fold lands would be
+        # skipped forever once it commits. The one-interval lag keeps
+        # every timestamp answerable/deduplicable for >= interval seqs
+        # after execution — far longer than any client retry window.
+        # The latest folded reply stays cached for replay answers.
+        horizon = seq - self.cfg.checkpoint_interval
+        for c, recent in self.recent_replies.items():
+            folded = [ts for ts, r in recent.items() if r.seq <= horizon]
+            if not folded:
+                continue
+            top = max(folded)
+            self.client_watermark[c] = max(
+                self.client_watermark.get(c, 0), top
+            )
+            for ts in folded:
+                if ts != top:
+                    del recent[ts]
         snap = self._checkpoint_snapshot()
         digest = snapshot_digest(snap)
         self.checkpoint_digests[seq] = digest
@@ -1062,21 +1108,31 @@ class Replica:
         try:
             import json
 
+            # parse EVERYTHING into temporaries first: a half-applied
+            # snapshot (app restored, reply map rejected) would leave the
+            # replica permanently diverged from the certified digest
             payload = json.loads(msg.snapshot)
-            self.app.restore(payload["app"])
             wm = payload["watermark"]
             replies = payload["replies"]
+            app_snap = payload["app"]
             if not isinstance(wm, dict) or not isinstance(replies, dict):
                 raise ValueError("bad snapshot envelope")
-            self.client_watermark = {str(c): int(t) for c, t in wm.items()}
-            restored = {}
-            for c, r in replies.items():
-                rep = Message.from_dict(r)
-                if not isinstance(rep, Reply):
-                    raise ValueError("bad reply in snapshot")
-                self.signer.sign_msg(rep)  # we vouch for the cached result
-                restored[str(c)] = rep
-            self.last_reply = restored
+            new_wm = {str(c): int(t) for c, t in wm.items()}
+            restored: Dict[str, Dict[int, Reply]] = {}
+            for c, per_ts in replies.items():
+                if not isinstance(per_ts, dict):
+                    raise ValueError("bad reply map in snapshot")
+                inner: Dict[int, Reply] = {}
+                for ts, r in per_ts.items():
+                    rep = Message.from_dict(r)
+                    if not isinstance(rep, Reply):
+                        raise ValueError("bad reply in snapshot")
+                    self.signer.sign_msg(rep)  # we vouch for the result
+                    inner[int(ts)] = rep
+                restored[str(c)] = inner
+            self.app.restore(app_snap)  # last: commit point
+            self.client_watermark = new_wm
+            self.recent_replies = restored
         except (ValueError, TypeError, KeyError):
             self.metrics["bad_snapshot"] += 1
             return

@@ -54,23 +54,24 @@ def test_forged_replies_never_match_and_valid_ones_do():
                         transport=t, request_timeout=2.0)
         client.start()
         task = asyncio.create_task(client.submit("op x", retries=0))
-        await asyncio.sleep(0.05)  # waiter for ts=1 registers
+        await asyncio.sleep(0.05)
+        (ts,) = client._waiters.keys()  # the live wall-clock timestamp
         # forged: signed by a key that is not the claimed sender's
         forger = Signer("evil", b"\xee" * 32)
         for rid in ("r0", "r1", "r2"):
-            msg = _reply(rid, "EVIL")
+            msg = _reply(rid, "EVIL", ts=ts)
             forger.sign_msg(msg)
             msg.sender = rid
             await t.q.put(msg.to_wire())
         # non-replica sender with a valid-for-itself signature
-        msg = _reply("nobody", "EVIL")
+        msg = _reply("nobody", "EVIL", ts=ts)
         forger.sign_msg(msg)
         await t.q.put(msg.to_wire())
         await asyncio.sleep(0.2)
         assert not task.done(), "forged replies must never reach f+1"
         # two honest matching replies (f+1 for n=4) resolve it
         for rid in ("r0", "r1"):
-            msg = _reply(rid, "ok")
+            msg = _reply(rid, "ok", ts=ts)
             Signer(rid, keys[rid].seed).sign_msg(msg)
             await t.q.put(msg.to_wire())
         assert await task == "ok"
@@ -105,8 +106,9 @@ def test_late_replies_after_match_skip_signature_work():
         client.start()
         task = asyncio.create_task(client.submit("op y", retries=0))
         await asyncio.sleep(0.05)
+        (ts,) = client._waiters.keys()
         for rid in ("r0", "r1"):
-            msg = _reply(rid, "done")
+            msg = _reply(rid, "done", ts=ts)
             Signer(rid, keys[rid].seed).sign_msg(msg)
             await t.q.put(msg.to_wire())
         assert await task == "done"
@@ -116,7 +118,7 @@ def test_late_replies_after_match_skip_signature_work():
         # drop them BEFORE verification (the throughput optimization
         # this suite pins) — the counter must not move
         for rid in ("r2", "r3"):
-            msg = _reply(rid, "divergent")
+            msg = _reply(rid, "divergent", ts=ts)
             Signer(rid, keys[rid].seed).sign_msg(msg)
             await t.q.put(msg.to_wire())
         await asyncio.sleep(0.1)
@@ -135,15 +137,16 @@ def test_conflicting_results_wait_for_true_quorum():
         client.start()
         task = asyncio.create_task(client.submit("op z", retries=0))
         await asyncio.sleep(0.05)
+        (ts,) = client._waiters.keys()
         # two replicas disagree (one Byzantine): no f+1 match yet
         for rid, res in (("r0", "A"), ("r1", "B")):
-            msg = _reply(rid, res)
+            msg = _reply(rid, res, ts=ts)
             Signer(rid, keys[rid].seed).sign_msg(msg)
             await t.q.put(msg.to_wire())
         await asyncio.sleep(0.2)
         assert not task.done()
         # a third replica agreeing with A completes f+1 on A
-        msg = _reply("r2", "A")
+        msg = _reply("r2", "A", ts=ts)
         Signer("r2", keys["r2"].seed).sign_msg(msg)
         await t.q.put(msg.to_wire())
         assert await task == "A"

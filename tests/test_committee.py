@@ -146,11 +146,17 @@ def test_duplicate_request_reexecutes_nothing():
         com.start()
         try:
             await com.clients[0].submit("put k 1")
-            # forge a retransmission of timestamp 1 by sending the same
-            # signed request again straight to the primary
+            # forge a retransmission of the EXECUTED timestamp (clients
+            # use wall-clock timestamps) straight to the primary
             from simple_pbft_tpu.messages import Request
 
-            req = Request(client_id="c0", timestamp=1, operation="put k 1")
+            primary = com.replica("r0")
+            for _ in range(100):  # submit returns on f+1; primary may lag
+                if primary.recent_replies.get("c0"):
+                    break
+                await asyncio.sleep(0.02)
+            (ts,) = primary.recent_replies["c0"].keys()
+            req = Request(client_id="c0", timestamp=ts, operation="put k 1")
             com.clients[0].signer.sign_msg(req)
             await com.clients[0].transport.send("r0", req.to_wire())
             await asyncio.sleep(0.2)

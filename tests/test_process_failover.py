@@ -1,0 +1,91 @@
+"""Failover across REAL processes and sockets.
+
+Every storm/failover test runs in-process over the simulated transport;
+this one spawns 4 replica OS processes (TCP and gRPC), SIGKILLs the
+view-0 primary's process mid-run, and drives a client through the
+view change — the whole deployment plane (deploy docs, node binary,
+wire transports, view-change protocol) failing over for real.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn_node(rid, deploy_dir, transport, env):
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "simple_pbft_tpu.node",
+            "--id", rid,
+            "--deploy-dir", deploy_dir,
+            "--transport", transport,
+            "--log-dir", "",
+        ],
+        env=env,
+        cwd=REPO,
+    )
+
+
+def _client(deploy_dir, transport, load, timeout, retries, env):
+    return subprocess.run(
+        [
+            sys.executable, "-m", "simple_pbft_tpu.client_cli",
+            "--id", "c0",
+            "--deploy-dir", deploy_dir,
+            "--transport", transport,
+            "--load", str(load),
+            "--concurrency", "4",
+            "--timeout", str(timeout),
+            "--retries", str(retries),
+        ],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=150,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("transport", ["tcp", "grpc"])
+def test_primary_process_sigkill_failover(tmp_path, transport):
+    sys.path.insert(0, REPO)
+    from simple_pbft_tpu import deploy
+
+    base_port = 9100 + (os.getpid() % 400) + (0 if transport == "tcp" else 450)
+    deploy.generate(
+        str(tmp_path), n=4, clients=1, base_port=base_port, view_timeout=1.0
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # children must never touch the chip
+    procs = {}
+    try:
+        for i in range(4):
+            procs[f"r{i}"] = _spawn_node(f"r{i}", str(tmp_path), transport, env)
+        time.sleep(1.5)  # listeners up
+        # a first wave commits under the view-0 primary
+        out = _client(str(tmp_path), transport, 4, 1.0, 10, env)
+        assert out.returncode == 0, (out.stdout[-500:], out.stderr[-500:])
+        assert '"ops": 4' in out.stdout, out.stdout[-500:]
+        # crash-stop the primary's PROCESS (no drain, no goodbye)
+        procs["r0"].send_signal(signal.SIGKILL)
+        procs["r0"].wait(timeout=10)
+        # the survivors must view-change and keep serving the client
+        out = _client(str(tmp_path), transport, 6, 2.0, 30, env)
+        assert out.returncode == 0, (out.stdout[-500:], out.stderr[-500:])
+        assert '"ops": 6' in out.stdout, out.stdout[-500:]
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
