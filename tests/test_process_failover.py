@@ -18,18 +18,18 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _spawn_node(rid, deploy_dir, transport, env):
-    return subprocess.Popen(
-        [
-            sys.executable, "-m", "simple_pbft_tpu.node",
-            "--id", rid,
-            "--deploy-dir", deploy_dir,
-            "--transport", transport,
-            "--log-dir", "",
-        ],
-        env=env,
-        cwd=REPO,
-    )
+def _spawn_node(rid, deploy_dir, transport, env, log_dir=""):
+    """log_dir="" disables the file sink; pass None for the default
+    per-node log file (the rejoin test reads it for shutdown stats)."""
+    argv = [
+        sys.executable, "-m", "simple_pbft_tpu.node",
+        "--id", rid,
+        "--deploy-dir", deploy_dir,
+        "--transport", transport,
+    ]
+    if log_dir is not None:
+        argv += ["--log-dir", log_dir]
+    return subprocess.Popen(argv, env=env, cwd=REPO)
 
 
 def _client(deploy_dir, transport, load, timeout, retries, env):
@@ -80,6 +80,65 @@ def test_primary_process_sigkill_failover(tmp_path, transport):
         out = _client(str(tmp_path), transport, 6, 2.0, 30, env)
         assert out.returncode == 0, (out.stdout[-500:], out.stderr[-500:])
         assert '"ops": 6' in out.stdout, out.stdout[-500:]
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.mark.slow
+def test_killed_replica_process_rejoins(tmp_path):
+    """Crash recovery across real processes: a SIGKILLed replica restarts
+    from scratch (no disk state), learns the committee moved on via the
+    f+1 view-change join rule + checkpoint certificates, state-transfers,
+    and participates again — verified by its own shutdown stats."""
+    import re
+
+    sys.path.insert(0, REPO)
+    from simple_pbft_tpu import deploy
+
+    base_port = 9550 + (os.getpid() % 400)
+    deploy.generate(
+        str(tmp_path), n=4, clients=1, base_port=base_port,
+        view_timeout=1.0, checkpoint_interval=4,
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = {}
+    try:
+        for i in range(4):
+            procs[f"r{i}"] = _spawn_node(f"r{i}", str(tmp_path), "tcp", env)
+        time.sleep(1.5)
+        out = _client(str(tmp_path), "tcp", 8, 2.0, 10, env)
+        assert out.returncode == 0, (out.stdout[-400:], out.stderr[-400:])
+        procs["r0"].send_signal(signal.SIGKILL)
+        procs["r0"].wait(timeout=10)
+        out = _client(str(tmp_path), "tcp", 8, 2.0, 20, env)
+        assert out.returncode == 0, (out.stdout[-400:], out.stderr[-400:])
+        # r0 rejoins with no state and must catch up (log_dir=None: the
+        # default per-node log file carries the shutdown stats we assert)
+        procs["r0"] = _spawn_node("r0", str(tmp_path), "tcp", env,
+                                  log_dir=None)
+        time.sleep(2)
+        out = _client(str(tmp_path), "tcp", 8, 2.0, 20, env)
+        assert out.returncode == 0, (out.stdout[-400:], out.stderr[-400:])
+        time.sleep(3)  # let r0 finish catching up
+        procs["r0"].send_signal(signal.SIGTERM)
+        procs["r0"].wait(timeout=10)
+        log = open(os.path.join(str(tmp_path), "log", "r0.log")).read()
+        stats = [ln for ln in log.splitlines() if "stats" in ln]
+        assert stats, "r0 must dump stats on shutdown"
+        committed = re.search(r'"committed_requests": (\d+)', stats[-1])
+        views = re.search(r'"views_installed": (\d+)', stats[-1])
+        # earlier history arrives via state-transfer snapshot, not
+        # execution, so r0's own counter covers only post-catch-up work
+        assert committed and int(committed.group(1)) >= 4, stats[-1][-300:]
+        assert views and int(views.group(1)) >= 1, stats[-1][-300:]
     finally:
         for p in procs.values():
             if p.poll() is None:
