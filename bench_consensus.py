@@ -89,9 +89,11 @@ async def run_config(
     storm: bool = False,
     qc_mode: bool = False,
     view_timeout: float = 0.0,
+    chaos: dict = None,
 ) -> dict:
     from simple_pbft_tpu.committee import LocalCommittee
     from simple_pbft_tpu.crypto.tpu_verifier import BUCKETS, TpuVerifier
+    from simple_pbft_tpu.transport.local import FaultPlan
 
     factory = None
     if verifier == "tpu":
@@ -126,12 +128,22 @@ async def run_config(
             file=sys.stderr,
         )
 
+    plan = None
+    if chaos:
+        plan = FaultPlan(
+            drop_rate=chaos["drop"],
+            delay_range=(0.0, chaos["delay"]),
+            duplicate_rate=chaos["dup"],
+            seed=chaos["seed"],
+        )
     com = LocalCommittee.build(
         n=n,
         clients=n_clients,
+        fault_plan=plan,
         verifier_factory=factory,
         max_batch=batch,
-        view_timeout=view_timeout or (30.0 if not storm else 3.0),
+        view_timeout=view_timeout
+        or (30.0 if not (storm or chaos) else 3.0),
         checkpoint_interval=64,
         watermark_window=1024,
         qc_mode=qc_mode,
@@ -198,6 +210,7 @@ async def run_config(
         "config": name,
         "n": n,
         "qc_mode": qc_mode,
+        "chaos": chaos or None,
         "verifier": verifier,
         "clients": n_clients,
         "outstanding": per_client * n_clients,
@@ -225,6 +238,11 @@ async def main() -> None:
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--storm", action="store_true")
     ap.add_argument(
+        "--chaos", default=None,
+        help="fault injection for the run, e.g. drop=0.02,delay=0.03,"
+        "dup=0.01,seed=42 (reproduces the committed soak numbers)",
+    )
+    ap.add_argument(
         "--view-timeout", type=float, default=0.0,
         help="failover timer override; the storm default (3 s) assumes "
         "view-change validation is fast — on a single-core host a 64-node "
@@ -251,6 +269,26 @@ async def main() -> None:
         "qc16": dict(name="bls-qc-n16", n=16, qc_mode=True),
         "qc64": dict(name="bls-qc-n64", n=64, qc_mode=True),
     }
+    chaos = None
+    if args.chaos:
+        try:
+            raw = dict(kv.split("=", 1) for kv in args.chaos.split(","))
+            if not raw or any(
+                k not in ("drop", "delay", "dup", "seed") for k in raw
+            ):
+                raise ValueError(args.chaos)
+            # resolve to effective numeric values (defaults included) so
+            # the emitted record reproduces the exact fault plan
+            chaos = {
+                "drop": float(raw.get("drop", 0.0)),
+                "delay": float(raw.get("delay", 0.0)),
+                "dup": float(raw.get("dup", 0.0)),
+                "seed": int(raw.get("seed", 42)),
+            }
+        except ValueError:
+            sys.exit(f"bad --chaos spec {args.chaos!r}: "
+                     f"use drop=0.02,delay=0.03,dup=0.01,seed=42")
+
     for key in args.configs.split(","):
         key = key.strip()
         if key not in ladder:
@@ -259,21 +297,20 @@ async def main() -> None:
                 f"{sorted(ladder)} (config 5, the view-change storm, "
                 f"runs via --storm over one of these committee sizes)"
             )
+        cfg = ladder[key]
         if args.storm:
-            cfg = ladder[key]
             rec = await run_config(
                 f"viewchange-storm-{cfg['name']}", cfg["n"], args.seconds,
                 args.clients, args.outstanding, args.verifier, args.batch,
                 storm=True, view_timeout=args.view_timeout,
-                qc_mode=cfg.get("qc_mode", False),
+                qc_mode=cfg.get("qc_mode", False), chaos=chaos,
             )
         else:
-            cfg = ladder[key]
             rec = await run_config(
                 cfg["name"], cfg["n"], args.seconds, args.clients,
                 args.outstanding, args.verifier, args.batch,
                 view_timeout=args.view_timeout,
-                qc_mode=cfg.get("qc_mode", False),
+                qc_mode=cfg.get("qc_mode", False), chaos=chaos,
             )
         _emit(rec)
 

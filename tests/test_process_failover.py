@@ -102,7 +102,9 @@ def test_killed_replica_process_rejoins(tmp_path):
     sys.path.insert(0, REPO)
     from simple_pbft_tpu import deploy
 
-    base_port = 9550 + (os.getpid() % 400)
+    # distinct range from the sigkill tests' 9100-9950 spread so a child
+    # outliving its SIGTERM grace can never squat this test's ports
+    base_port = 10100 + (os.getpid() % 400)
     deploy.generate(
         str(tmp_path), n=4, clients=1, base_port=base_port,
         view_timeout=1.0, checkpoint_interval=4,
@@ -127,17 +129,23 @@ def test_killed_replica_process_rejoins(tmp_path):
         time.sleep(2)
         out = _client(str(tmp_path), "tcp", 8, 2.0, 20, env)
         assert out.returncode == 0, (out.stdout[-400:], out.stderr[-400:])
-        time.sleep(3)  # let r0 finish catching up
+        time.sleep(5)  # let r0 finish catching up
         procs["r0"].send_signal(signal.SIGTERM)
         procs["r0"].wait(timeout=10)
         log = open(os.path.join(str(tmp_path), "log", "r0.log")).read()
         stats = [ln for ln in log.splitlines() if "stats" in ln]
         assert stats, "r0 must dump stats on shutdown"
         committed = re.search(r'"committed_requests": (\d+)', stats[-1])
+        synced = re.search(r'"state_syncs": (\d+)', stats[-1])
         views = re.search(r'"views_installed": (\d+)', stats[-1])
-        # earlier history arrives via state-transfer snapshot, not
-        # execution, so r0's own counter covers only post-catch-up work
-        assert committed and int(committed.group(1)) >= 4, stats[-1][-300:]
+        # r0 must have PARTICIPATED again: either it executed part of the
+        # third wave, or (if state transfer snapshot-jumped past it) it
+        # applied a sync — history behind the snapshot never increments
+        # the execution counter
+        participated = (committed and int(committed.group(1)) >= 1) or (
+            synced and int(synced.group(1)) >= 1
+        )
+        assert participated, stats[-1][-300:]
         assert views and int(views.group(1)) >= 1, stats[-1][-300:]
     finally:
         for p in procs.values():
