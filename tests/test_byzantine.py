@@ -17,19 +17,42 @@ import pytest
 
 from simple_pbft_tpu.committee import LocalCommittee
 from simple_pbft_tpu.crypto.signer import Signer
-from simple_pbft_tpu.messages import Message, PrePrepare, Prepare, Request
+from simple_pbft_tpu.messages import Commit, Message, PrePrepare, Prepare, Request
 
 
-class EquivocatingTransport:
-    """Wraps a Byzantine replica's transport: pre-prepares are FORKED —
-    half the committee receives the real block, the other half a
-    validly-signed substitute with a different block — and half of its
-    prepare votes lie about the digest (also validly signed)."""
+class PassthroughTransport:
+    """Base for Byzantine transport wrappers: subclasses override
+    _mutate(raw) and/or broadcast."""
 
     def __init__(self, inner, signer: Signer):
         self.inner = inner
         self.signer = signer
         self.node_id = inner.node_id
+
+    def _mutate(self, raw):
+        return raw
+
+    async def send(self, dest, raw):
+        await self.inner.send(dest, self._mutate(raw))
+
+    async def broadcast(self, raw, dests):
+        await self.inner.broadcast(self._mutate(raw), dests)
+
+    async def recv(self):
+        return await self.inner.recv()
+
+    def recv_nowait(self):
+        return self.inner.recv_nowait()
+
+
+class EquivocatingTransport(PassthroughTransport):
+    """Pre-prepares are FORKED — half the committee receives the real
+    block, the other half a validly-signed substitute with a different
+    block — and half of its prepare votes lie about the digest (also
+    validly signed)."""
+
+    def __init__(self, inner, signer: Signer):
+        super().__init__(inner, signer)
         self.forked = 0
 
     def _fork_pre_prepare(self, pp: PrePrepare) -> bytes:
@@ -45,9 +68,6 @@ class EquivocatingTransport:
         )
         self.signer.sign_msg(forked)
         return forked.to_wire()
-
-    async def send(self, dest, raw):
-        await self.inner.send(dest, raw)
 
     async def broadcast(self, raw, dests):
         try:
@@ -65,12 +85,6 @@ class EquivocatingTransport:
             self.signer.sign_msg(lie)
             raw = lie.to_wire()
         await self.inner.broadcast(raw, dests)
-
-    async def recv(self):
-        return await self.inner.recv()
-
-    def recv_nowait(self):
-        return self.inner.recv_nowait()
 
 
 @pytest.mark.slow
@@ -116,6 +130,75 @@ def test_equivocating_primary_safety_and_liveness():
             assert ok >= 20, ok
             # the equivocator really did equivocate
             assert evil.transport.forked >= 1
+        finally:
+            await c.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 120))
+
+
+class SharePoisoningTransport(PassthroughTransport):
+    """QC-mode Byzantine backup: ALL its votes (prepare and commit)
+    carry a VALID Ed25519 signature and the correct digest, but a
+    garbage-yet-on-curve BLS share — the poison only surfaces when the
+    primary aggregates, forcing the bisection path under live traffic."""
+
+    def __init__(self, inner, signer: Signer):
+        super().__init__(inner, signer)
+        self.poisoned = 0
+
+    def _mutate(self, raw):
+        try:
+            msg = Message.from_wire(raw)
+        except ValueError:
+            return raw
+        if isinstance(msg, (Prepare, Commit)) and getattr(
+            msg, "bls_share", ""
+        ):
+            from simple_pbft_tpu.crypto import bls
+
+            # a real G1 point that is NOT a share over the payload
+            bogus = bls.sign(12345, b"not the payload")
+            msg.bls_share = bogus.hex()
+            self.signer.sign_msg(msg)
+            self.poisoned += 1
+            return msg.to_wire()
+        return raw
+
+
+@pytest.mark.slow
+def test_qc_byzantine_share_poisoner_is_bisected_out():
+    async def main():
+        from simple_pbft_tpu.transport.local import FaultPlan
+
+        # delay r3's traffic so the poisoner's votes are always within
+        # the first 2f+1 the primary aggregates (otherwise the test's
+        # bisection assertion would depend on scheduling luck)
+        plan = FaultPlan(seed=3)
+        c = LocalCommittee.build(n=4, clients=1, qc_mode=True,
+                                 view_timeout=6.0, fault_plan=plan)
+        real_deliver = c.net._deliver
+        async def slow_r3(src, dst, raw):
+            if src == "r3":
+                await asyncio.sleep(0.15)
+            await real_deliver(src, dst, raw)
+        c.net._deliver = slow_r3
+        evil = c.replica("r1")  # a BACKUP poisons its vote shares
+        evil.transport = SharePoisoningTransport(
+            evil.transport, Signer("r1", c.keys["r1"].seed)
+        )
+        c.clients[0].request_timeout = 8.0
+        c.start()
+        try:
+            for i in range(3):
+                assert await c.clients[0].submit(f"put p{i} {i}",
+                                                 retries=10) == "ok"
+            assert evil.transport.poisoned >= 1
+            # the primary detected and excluded the poisoned shares
+            primary = c.replica("r0")
+            assert primary.metrics.get("qc_bad_shares", 0) >= 1, dict(
+                primary.metrics
+            )
+            assert primary.metrics.get("qcs_formed", 0) >= 1
         finally:
             await c.stop()
 
