@@ -17,15 +17,21 @@ prep is timed and reported separately in the JSON for honesty; the headline
 is device throughput (host prep overlaps with device compute in the
 pipelined runtime — see crypto/tpu_verifier.py).
 
-Env knobs: BENCH_BATCH (top batch size), BENCH_SIGNERS, BENCH_TIMEOUT
-(wall-clock budget in seconds, default 420), BENCH_MODE (fused|comb —
-fused is one gather + one mixed add per nibble position, half the comb
-engine's madds), BENCH_MUL (skew|padacc field-multiply formulation),
-BENCH_ACCUM (auto|xla|pallas madd-loop implementation; auto = pallas on
-real TPU), BENCH_PALLAS_TILE (batch lanes per Pallas program),
-BENCH_RAMP=fast (skip intermediate ladder steps — experiments),
---smoke (tiny CPU run for CI). The JSON also reports
-e2e_verifies_per_sec: the overlapped host-prep + transfer + device rate.
+Env knobs: BENCH_BATCH (top batch size; capped at 8192 unless
+BENCH_ALLOW_BIG=1 — a killed 16384+ compile wedged the device tunnel for
+hours once, so big compiles never run inside the default driver budget),
+BENCH_SIGNERS, BENCH_TIMEOUT (wall-clock budget in seconds, default 420),
+BENCH_MODE (fused|comb — fused is one gather + one mixed add per nibble
+position, half the comb engine's madds), BENCH_WINDOW (fused window bits,
+4|5|6), BENCH_MUL (skew|padacc field-multiply formulation), BENCH_ACCUM
+(auto|xla|pallas madd-loop implementation; auto = pallas on real TPU),
+BENCH_PALLAS_TILE (batch lanes per Pallas program), BENCH_RAMP
+(fast|full; default fast = one small fail-fast compile then the top
+batch — fastest path to a steady-state number under the driver budget;
+full = the whole power-of-two ladder), BENCH_CACHE=0 (disable the
+persistent jit cache), --smoke (tiny CPU run for CI). The JSON also
+reports e2e_verifies_per_sec: the overlapped host-prep + transfer +
+device rate.
 """
 
 from __future__ import annotations
@@ -101,7 +107,19 @@ def main() -> None:
     _start_watchdog(budget)
     t_start = time.perf_counter()
 
+    # The note rides along in the timeout JSON — keep it pointing at the
+    # exact stage so a wedged run says *where* it wedged (backend init is
+    # the historical culprit: a remote-device tunnel can hang jax.devices()
+    # indefinitely).
+    _best["note"] = "initializing jax backend"
     import jax
+
+    if os.environ.get("BENCH_CACHE", "1") != "0":
+        # Persistent compile cache: a re-run after a timeout (or the
+        # driver's run after an experiment) skips straight to measuring.
+        from simple_pbft_tpu import enable_jit_cache
+
+        enable_jit_cache()
 
     if "--smoke" in sys.argv:
         # CPU, tiny batch: CI-checkable in seconds. The ambient
@@ -135,10 +153,19 @@ def main() -> None:
     assert mode in ("fused", "comb"), mode
     # comb mode is fixed at 4-bit windows; report what actually runs
     wbits = int(os.environ.get("BENCH_WINDOW", "4")) if mode == "fused" else 4
+    _best["note"] = "querying devices (tunnel attach)"
     platform = jax.devices()[0].platform
+    _best["note"] = f"devices up ({platform}); preparing batch"
     top_batch = int(os.environ.get("BENCH_BATCH", str(BUCKETS[-1])))
     # comb kernel's batch inversion needs a power-of-two batch
     top_batch = 1 << max(0, top_batch - 1).bit_length()
+    if top_batch > BUCKETS[-1] and os.environ.get("BENCH_ALLOW_BIG") != "1":
+        print(
+            f"capping batch {top_batch} -> {BUCKETS[-1]} "
+            "(BENCH_ALLOW_BIG=1 to override)",
+            file=sys.stderr,
+        )
+        top_batch = BUCKETS[-1]
     # committee-shaped workload: 16 signers (BASELINE config 2), distinct
     # messages per signer
     n_signers = int(os.environ.get("BENCH_SIGNERS", "16"))
@@ -193,10 +220,14 @@ def main() -> None:
 
     # Ramp: compile small first so a wedged device / runaway compile fails
     # inside the watchdog window with a useful note, then step up through
-    # power-of-two batches while time and measured rate justify it. The
-    # requested top batch is always included even beyond BUCKETS[-1].
-    if os.environ.get("BENCH_RAMP") == "fast":
-        # experiment mode: one small fail-fast compile, then the top batch
+    # power-of-two batches while time and measured rate justify it.
+    # Default is the fast ramp — two compiles is the quickest route to a
+    # steady-state number, and an environment hiccup mid-run then still
+    # leaves a real measurement for the watchdog to report.
+    ramp = os.environ.get("BENCH_RAMP", "fast")
+    assert ramp in ("fast", "full"), ramp
+    if ramp != "full":
+        # one small fail-fast compile, then the top batch
         ladder = sorted({effective(min(64, top_batch)), effective(top_batch)})
     else:
         ladder = sorted(
