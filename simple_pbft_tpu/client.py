@@ -26,6 +26,15 @@ from .messages import Message, Reply, Request
 from .transport.base import Transport
 
 
+class SupersededError(Exception):
+    """f+1 replicas answered with Reply.superseded=1: the request's
+    timestamp fell under a folded checkpoint watermark and the operation
+    was NOT applied by this submission. Whether to resubmit is the
+    application's call — the same answer is given for a request that DID
+    execute long ago but whose cached reply was folded away, so a blind
+    automatic retry could apply a non-idempotent operation twice."""
+
+
 class Client:
     def __init__(
         self,
@@ -53,7 +62,8 @@ class Client:
         # slewing (not stepping) time sync, or persist the last timestamp.
         self._ts = itertools.count(int(time.time() * 1_000_000))
         self._waiters: Dict[int, asyncio.Future] = {}
-        self._replies: Dict[int, Dict[str, str]] = defaultdict(dict)
+        # per-ts replies: sender -> (result, superseded) — matched as a pair
+        self._replies: Dict[int, Dict[str, tuple]] = defaultdict(dict)
         self._task: Optional[asyncio.Task] = None
         self.view_hint = 0  # latest view seen in replies
 
@@ -113,17 +123,25 @@ class Client:
         # outcome — matching on (result, view) would deadlock exactly
         # when a view change lands mid-request. The view rides along
         # purely as the primary hint above.
-        self._replies[ts][msg.sender] = msg.result
-        counts: Dict[str, int] = defaultdict(int)
+        self._replies[ts][msg.sender] = (msg.result, bool(msg.superseded))
+        counts: Dict[tuple, int] = defaultdict(int)
         for val in self._replies[ts].values():
             counts[val] += 1
-        for result, cnt in counts.items():
+        for (result, superseded), cnt in counts.items():
             if cnt >= self.cfg.weak_quorum:
-                fut.set_result(result)
+                if superseded:
+                    fut.set_exception(SupersededError())
+                else:
+                    fut.set_result(result)
                 return
 
     async def submit(self, operation: str, retries: int = 3) -> str:
-        """Submit one operation; return the f+1-matched result."""
+        """Submit one operation; return the f+1-matched result.
+
+        Raises SupersededError if the committee reports the request's
+        slot was folded under a checkpoint watermark (the op was not
+        applied by this call — see the exception's docstring before
+        resubmitting non-idempotent operations)."""
         ts = next(self._ts)
         req = Request(client_id=self.id, timestamp=ts, operation=operation)
         self.signer.sign_msg(req)
@@ -139,6 +157,7 @@ class Client:
             )
             for attempt in range(retries + 1):
                 try:
+                    # a SupersededError set on the future raises here
                     return await asyncio.wait_for(
                         asyncio.shield(fut), self.request_timeout
                     )

@@ -134,8 +134,13 @@ class Replica:
         # QC mode: lazily-built aggregate checkpoint certificates, by seq
         # (built on first view-change need, not per stabilization)
         self.checkpoint_qcs: Dict[int, QuorumCert] = {}
-        # detached re-issues awaiting a BlockReply, by digest (bounded)
-        self.block_pending: Dict[str, PrePrepare] = {}
+        # detached re-issues awaiting a BlockReply: digest -> per-(view,
+        # seq) waiters. A digest can have MULTIPLE waiting slots (a
+        # Byzantine primary can get the same block prepared at two seqs,
+        # so two O-set entries share a digest) — one BlockReply must
+        # replay every waiter, not just the last one buffered.
+        self.block_pending: Dict[str, Dict[Tuple[int, int], PrePrepare]] = {}
+        self._fetch_rotation = 0  # rotating BlockFetch target window
         self.vc = ViewChanger(self)
         # QC mode: BLS share-signing key + per-(view, seq, phase) record of
         # certificates this replica (as primary) already aggregated
@@ -508,6 +513,13 @@ class Replica:
                 # client is retrying something still unexecuted: the
                 # primary may be faulty — (re)arm the failover timer
                 self.vc.arm()
+            elif req.timestamp <= floor:
+                # below the fold with no cached reply and no in-flight
+                # trace: the reply was folded away (or the slot lost to
+                # the fold) — answer definitively instead of leaving the
+                # retry unanswered (deterministic across honest replicas:
+                # floor and reply cache are checkpoint state)
+                await self._send_superseded(self.view, self.stable_seq, req)
             return
         if self.is_primary:
             self.seen_requests[key] = 0  # 0 = queued, not yet assigned
@@ -774,15 +786,28 @@ class Replica:
             for req in reqs:
                 self.relay_buffer.pop((req.client_id, req.timestamp), None)
                 recent = self.recent_replies.get(req.client_id, {})
-                if (
-                    req.timestamp <= self.client_watermark.get(req.client_id, 0)
-                    or req.timestamp in recent
-                ):
+                if req.timestamp in recent:
                     # EXACT-ts replay that slipped into a block: no-op.
                     # (A max-ts watermark here would skip lower timestamps
                     # of a pipelined client whose requests committed out
                     # of order after a failover — deadlocking the client.)
                     self.metrics["exec_replay_skipped"] += 1
+                    continue
+                if req.timestamp <= self.client_watermark.get(req.client_id, 0):
+                    # At/below the folded watermark with no cached reply:
+                    # either a replay whose reply the checkpoint fold
+                    # already discarded, or a pipelined client's lower
+                    # timestamp that stayed in flight across a whole
+                    # checkpoint interval while a higher sibling executed.
+                    # Post-fold the two are indistinguishable, so never
+                    # re-apply (at-most-once execution) — but DO answer.
+                    # Watermark and reply cache are checkpoint state,
+                    # identical on every honest replica, so the client
+                    # gets f+1 matching SUPERSEDED replies (an explicit
+                    # "resubmit with a fresh timestamp") instead of
+                    # hanging forever on a silently dropped request.
+                    self.metrics["exec_replay_skipped"] += 1
+                    await self._send_superseded(act.view, act.seq, req)
                     continue
                 result = self.app.apply(req.operation)
                 self.metrics["committed_requests"] += 1
@@ -801,6 +826,21 @@ class Replica:
             if self.executed_seq % self.cfg.checkpoint_interval == 0:
                 await self._emit_checkpoint(self.executed_seq)
             self.vc.reset()  # commits are progress: the primary is alive
+
+    async def _send_superseded(self, view: int, seq: int, req) -> None:
+        """Answer with Reply.superseded=1 (see messages.Reply): the
+        client library surfaces f+1 of these as SupersededError —
+        resubmitting is the APPLICATION's call (the op may have executed
+        before the fold, so a blind auto-retry could double-apply)."""
+        reply = Reply(
+            view=view,
+            seq=seq,
+            client_id=req.client_id,
+            timestamp=req.timestamp,
+            superseded=1,
+        )
+        self.signer.sign_msg(reply)
+        await self.transport.send(req.client_id, reply.to_wire())
 
     # ------------------------------------------------------------------
     # checkpoints / watermarks
@@ -996,11 +1036,20 @@ class Replica:
             digest=pp.digest, block=ent[1],
         )
 
+    MAX_WAITERS_PER_DIGEST = 32  # Byzantine same-digest-many-seqs bound
+
     def buffer_for_block(self, pp: PrePrepare) -> None:
-        if len(self.block_pending) < self.MAX_PENDING_BLOCKS:
-            self.block_pending[pp.digest] = pp
-        else:
+        waiters = self.block_pending.get(pp.digest)
+        if waiters is None:
+            if len(self.block_pending) >= self.MAX_PENDING_BLOCKS:
+                self.metrics["block_pending_overflow"] += 1
+                return
+            waiters = self.block_pending[pp.digest] = {}
+        key = (pp.view, pp.seq)
+        if key not in waiters and len(waiters) >= self.MAX_WAITERS_PER_DIGEST:
             self.metrics["block_pending_overflow"] += 1
+            return
+        waiters[key] = pp
 
     def prune_stale_block_pending(self, new_view: int) -> None:
         """Entries buffered under earlier views are dead: the new install
@@ -1008,21 +1057,30 @@ class Replica:
         and a stale entry would otherwise hold has_outstanding_work()
         true forever, firing the failover timer on an idle committee."""
         self.block_pending = {
-            dg: pp for dg, pp in self.block_pending.items()
-            if pp.view >= new_view
+            dg: kept
+            for dg, waiters in self.block_pending.items()
+            if (kept := {
+                k: pp for k, pp in waiters.items() if pp.view >= new_view
+            })
         }
 
     async def request_blocks(self, digests: List[str]) -> None:
-        """Ask f+1 peers for blocks behind re-issued digests — at least
-        one is honest and (having contributed a prepared certificate or
-        validated the NEW-VIEW) holds them; a broadcast would n-fold the
-        multi-MB replies during failover congestion. Liveness fallback:
-        if no targeted peer answers, the view-change timer fires again."""
+        """Ask f+1 peers for blocks behind re-issued digests, rotating
+        the target window each call: a FIXED first-f+1 pick can be f
+        honest-but-lagging non-signers plus one silent Byzantine signer,
+        in which case no target ever answers and recovery would stall
+        until state transfer. Rotation reaches every peer within a few
+        timer re-fires. A broadcast would n-fold the multi-MB replies
+        during failover congestion. Liveness fallback: if no targeted
+        peer answers, the view-change timer fires again."""
         peers = [r for r in self.cfg.replica_ids if r != self.id]
-        targets = peers[: self.cfg.weak_quorum]
+        k = min(self.cfg.weak_quorum, len(peers))
+        start = self._fetch_rotation % max(1, len(peers))
+        self._fetch_rotation += k
+        targets = (peers + peers)[start : start + k]
         want = sorted(set(digests))
-        for start in range(0, len(want), 256):  # chunk, don't truncate
-            fetch = BlockFetch(digests=want[start : start + 256])
+        for off in range(0, len(want), 256):  # chunk, don't truncate
+            fetch = BlockFetch(digests=want[off : off + 256])
             self.signer.sign_msg(fetch)
             self.metrics["block_fetches_sent"] += 1
             wire = fetch.to_wire()
@@ -1069,22 +1127,25 @@ class Replica:
             if PrePrepare.block_digest(block) != dg:
                 self.metrics["bad_block_reply"] += 1
                 continue
-            pp = self.block_pending.pop(dg, None)
-            if pp is None:
+            waiters = self.block_pending.pop(dg, None)
+            if not waiters:
                 continue
-            self.store_block(pp.seq, dg, block)
-            if pp.view != self.view:
-                self.metrics["stale_block_reply"] += 1
-                continue
-            filled = PrePrepare(
-                sender=pp.sender, sig=pp.sig, view=pp.view, seq=pp.seq,
-                digest=dg, block=block,
-            )
-            self.metrics["blocks_fetched"] += 1
-            if filled.seq > self.stable_seq + self.cfg.watermark_window:
-                self.vc_replay[filled.seq] = filled
-            else:
-                await self._on_phase(filled)
+            # replay EVERY waiting slot (a digest can be pending at
+            # several (view, seq) keys), in deterministic order
+            for _, pp in sorted(waiters.items()):
+                self.store_block(pp.seq, dg, block)
+                if pp.view != self.view:
+                    self.metrics["stale_block_reply"] += 1
+                    continue
+                filled = PrePrepare(
+                    sender=pp.sender, sig=pp.sig, view=pp.view, seq=pp.seq,
+                    digest=dg, block=block,
+                )
+                self.metrics["blocks_fetched"] += 1
+                if filled.seq > self.stable_seq + self.cfg.watermark_window:
+                    self.vc_replay[filled.seq] = filled
+                else:
+                    await self._on_phase(filled)
 
     async def _on_state_request(self, msg: StateRequest) -> None:
         snap = self.snapshots.get(msg.seq)
@@ -1179,7 +1240,11 @@ class Replica:
             dg: (s, b) for dg, (s, b) in self.block_store.items() if s > seq
         }
         self.block_pending = {
-            dg: pp for dg, pp in self.block_pending.items() if pp.seq > seq
+            dg: kept
+            for dg, waiters in self.block_pending.items()
+            if (kept := {
+                k: pp for k, pp in waiters.items() if pp.seq > seq
+            })
         }
         # keep the aggregate AT the new watermark (the next VIEW-CHANGE
         # proves exactly this h); older ones are dead
@@ -1190,6 +1255,15 @@ class Replica:
         self.seen_requests = {
             (c, ts): assigned
             for (c, ts), assigned in self.seen_requests.items()
+            if ts > self.client_watermark.get(c, 0)
+        }
+        # relay_buffer must fold with the watermark too: a stale
+        # below-floor entry on a backup would (a) shadow the SUPERSEDED
+        # retry answer forever (the dup branch sees it "in flight") and
+        # (b) hold has_outstanding_work() true, arming spurious failovers
+        self.relay_buffer = {
+            (c, ts): r
+            for (c, ts), r in self.relay_buffer.items()
             if ts > self.client_watermark.get(c, 0)
         }
 

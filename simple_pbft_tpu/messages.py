@@ -233,6 +233,12 @@ class Reply(Message):
     client_id: str = ""
     timestamp: int = 0
     result: str = ""
+    #: 1 = the request's timestamp fell at/below a folded checkpoint
+    #: watermark with no cached reply: the operation was NOT (re-)applied
+    #: and ``result`` carries no application data. A dedicated field, not
+    #: an in-band reserved result string — nothing stops an application
+    #: from legitimately storing/returning any string.
+    superseded: int = 0
 
 
 # ---------------------------------------------------------------------------

@@ -223,6 +223,11 @@ class GrpcTransport:
 
     async def send(self, dest: str, raw: bytes) -> None:
         if dest == self.node_id:
+            # same byte-cap as _on_stream: un-accounted self-frames would
+            # push _recv_bytes past the cap and starve inbound peer frames
+            if len(raw) + self._recv_bytes > RECV_BUFFER_BYTES:
+                self.metrics["dropped_recv"] += 1
+                return
             try:
                 self._recv_q.put_nowait(raw)
                 self._recv_bytes += len(raw)

@@ -1,0 +1,230 @@
+"""Directed tests for the round-2 advisor findings (ADVICE.md):
+
+1. block_pending must key waiters per (view, seq) — a Byzantine primary
+   can get the SAME block prepared at two sequence numbers, and one
+   BlockReply must release both detached pre-prepares.
+2. BlockFetch targets must rotate: a fixed first-f+1 pick can be f
+   honest-but-lagging non-signers plus one silent Byzantine signer.
+3. A request folded under the checkpoint watermark with no cached reply
+   must get an explicit SUPERSEDED reply (exec path and retry path),
+   not a silent permanent drop that hangs the client.
+4. The gRPC self-delivery path must honor RECV_BUFFER_BYTES like the
+   inbound-stream path, so local frames can't starve peer frames.
+"""
+
+import asyncio
+
+from simple_pbft_tpu.committee import LocalCommittee
+from simple_pbft_tpu.messages import BlockReply, PrePrepare, Request
+
+
+def run(coro, timeout=30):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+class CapturingTransport:
+    """Records (dest, raw) of every send; drops broadcasts silently."""
+
+    def __init__(self, node_id):
+        self.node_id = node_id
+        self.sent = []
+
+    async def send(self, dest, raw):
+        self.sent.append((dest, raw))
+
+    async def broadcast(self, raw, dests):
+        pass
+
+
+def test_block_reply_releases_every_waiting_slot():
+    """One digest pending at two (view, seq) slots -> one BlockReply
+    replays BOTH detached pre-prepares (the old digest-keyed buffer
+    silently overwrote the first waiter)."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4)
+        backup = com.replica("r1")
+        primary_signer = com.replica("r0").signer
+        block = [{"op": "noop"}]
+        digest = PrePrepare.block_digest(block)
+        for seq in (1, 2):
+            pp = PrePrepare(view=0, seq=seq, digest=digest, block=None)
+            primary_signer.sign_msg(pp)
+            backup.buffer_for_block(pp)
+        assert len(backup.block_pending[digest]) == 2
+
+        reply = BlockReply(blocks=[{"digest": digest, "block": block}])
+        com.replica("r2").signer.sign_msg(reply)
+        await backup._on_block_reply(reply)
+        # both waiters released and counted; nothing left pending
+        assert backup.metrics["blocks_fetched"] == 2
+        assert digest not in backup.block_pending
+
+    run(scenario())
+
+
+def test_block_fetch_targets_rotate():
+    async def scenario():
+        com = LocalCommittee.build(n=7)  # f=2: fetch targets f+1=3 peers
+        rep = com.replica("r0")
+        cap = CapturingTransport("r0")
+        rep.transport = cap
+        await rep.request_blocks(["d1"])
+        first = {d for d, _ in cap.sent}
+        cap.sent.clear()
+        await rep.request_blocks(["d1"])
+        second = {d for d, _ in cap.sent}
+        assert len(first) == len(second) == rep.cfg.weak_quorum
+        # rotation: consecutive retries must not re-ask the same set
+        assert first != second
+        # and over enough retries every peer gets asked
+        seen = first | second
+        for _ in range(4):
+            cap.sent.clear()
+            await rep.request_blocks(["d1"])
+            seen |= {d for d, _ in cap.sent}
+        assert seen == {r for r in rep.cfg.replica_ids if r != "r0"}
+
+    run(scenario())
+
+
+def test_superseded_reply_instead_of_silent_drop():
+    """Retry of a timestamp at/below the folded watermark with no cached
+    reply -> an explicit SUPERSEDED reply, deterministic across replicas."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4)
+        rep = com.replica("r1")
+        cap = CapturingTransport("r1")
+        rep.transport = cap
+        client = com.clients[0]
+        # simulate the post-fold state: watermark advanced, reply folded
+        rep.client_watermark["c0"] = 100
+        req = Request(client_id="c0", timestamp=50, operation="put k v")
+        client.signer.sign_msg(req)
+        await rep._on_request(req)
+        assert len(cap.sent) == 1
+        from simple_pbft_tpu.messages import Message, Reply
+
+        dest, raw = cap.sent[0]
+        reply = Message.from_wire(raw)
+        assert dest == "c0"
+        assert isinstance(reply, Reply)
+        assert reply.superseded == 1
+        assert reply.timestamp == 50
+
+    run(scenario())
+
+
+def test_superseded_reply_on_exec_of_folded_timestamp():
+    """A below-watermark request that slips into a committed block is NOT
+    re-applied but the client hears about it (exec path)."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4)
+        com.start()
+        try:
+            assert await com.clients[0].submit("put k v1") == "ok"
+            rep0 = com.replica("r0")
+            # submit() returns on f+1 replies — r0 may lag; wait for it
+            t0 = asyncio.get_running_loop().time()
+            while (
+                rep0.metrics["committed_requests"] == 0
+                and asyncio.get_running_loop().time() - t0 < 10
+            ):
+                await asyncio.sleep(0.02)
+            for rep in com.replicas:
+                rep.client_watermark["c0"] = 10**9
+                rep.recent_replies.get("c0", {}).clear()
+            applied_before = rep0.metrics["committed_requests"]
+            # a fresh submit uses a now-stale timestamp? No — force one:
+            # craft a signed request below the watermark and inject it
+            # into the primary's pending queue directly (as if an old
+            # request had been stuck in a failover replay).
+            req = Request(client_id="c0", timestamp=5, operation="put k v2")
+            com.clients[0].signer.sign_msg(req)
+            rep0.pending_requests.append(req)
+            await rep0._propose_if_ready()
+            t0 = asyncio.get_running_loop().time()
+            while (
+                rep0.metrics["exec_replay_skipped"] == 0
+                and asyncio.get_running_loop().time() - t0 < 10
+            ):
+                await asyncio.sleep(0.02)
+            assert rep0.metrics["exec_replay_skipped"] >= 1
+            # not applied: the KV value is unchanged
+            assert rep0.metrics["committed_requests"] == applied_before
+            assert rep0.app.apply("get k") == "v1"
+        finally:
+            await com.stop()
+
+    run(scenario())
+
+
+def test_stale_relay_buffer_folds_with_watermark():
+    """Backup relay_buffer entries at/below the client watermark must be
+    GC'd: a stale entry would shadow the SUPERSEDED retry answer (the dup
+    branch sees it 'in flight') and keep arming spurious failovers."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4)
+        backup = com.replica("r1")
+        req = Request(client_id="c0", timestamp=50, operation="put k v")
+        com.clients[0].signer.sign_msg(req)
+        backup.relay_buffer[("c0", 50)] = req
+        backup.seen_requests[("c0", 50)] = 0
+        backup.client_watermark["c0"] = 100
+        backup._advance_stable(backup.stable_seq + 1)
+        assert ("c0", 50) not in backup.relay_buffer
+        assert ("c0", 50) not in backup.seen_requests
+        # and the retry now gets the definitive answer
+        cap = CapturingTransport("r1")
+        backup.transport = cap
+        await backup._on_request(req)
+        from simple_pbft_tpu.messages import Message, Reply
+
+        assert len(cap.sent) == 1
+        reply = Message.from_wire(cap.sent[0][1])
+        assert isinstance(reply, Reply) and reply.superseded == 1
+
+    run(scenario())
+
+
+def test_client_submit_raises_superseded():
+    """End-to-end: f+1 SUPERSEDED replies surface as SupersededError, not
+    as a fake result string handed to the application."""
+    import itertools
+
+    import pytest
+
+    from simple_pbft_tpu.client import SupersededError
+
+    async def scenario():
+        com = LocalCommittee.build(n=4)
+        com.start()
+        try:
+            for rep in com.replicas:
+                rep.client_watermark["c0"] = 10**18
+            com.clients[0]._ts = itertools.count(1000)  # below the floor
+            with pytest.raises(SupersededError):
+                await com.clients[0].submit("put k v")
+        finally:
+            await com.stop()
+
+    run(scenario())
+
+
+def test_grpc_self_send_respects_recv_buffer_cap():
+    from simple_pbft_tpu.transport.grpc import GrpcTransport
+    from simple_pbft_tpu.transport.tcp import RECV_BUFFER_BYTES
+
+    async def scenario():
+        t = GrpcTransport("n0", ("127.0.0.1", 0), peers={})
+        t._recv_bytes = RECV_BUFFER_BYTES - 10
+        await t.send("n0", b"x" * 100)  # would blow past the cap
+        assert t.metrics["dropped_recv"] == 1
+        assert t._recv_q.qsize() == 0
+        await t.send("n0", b"x" * 5)  # still fits
+        assert t._recv_q.qsize() == 1
+
+    run(scenario())
