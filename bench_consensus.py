@@ -64,16 +64,30 @@ def _start_watchdog(budget: float) -> None:
     threading.Thread(target=fire, daemon=True).start()
 
 
+from simple_pbft_tpu.client import SupersededError
+
+
 async def _pump(client, stop_at: float, latencies: List[float], errors: List[int]):
     """One closed-loop driver: keep exactly one request in flight, record
-    per-request latency. Concurrency comes from running many of these."""
+    per-request latency. Concurrency comes from running many of these.
+    Retries are sized so total client patience (~(retries+1) x timeout)
+    exceeds any plausible failover stall — a request abandoned by the
+    pump vanishes from the latency distribution, silently flattering
+    p99 exactly when the system was slowest."""
     i = 0
+    retries = max(3, int(40.0 / max(client.request_timeout, 0.1)))
     while time.perf_counter() < stop_at:
         t0 = time.perf_counter()
         try:
-            await client.submit(f"put k{id(client) % 997}_{i % 64} {i}")
+            await client.submit(
+                f"put k{id(client) % 997}_{i % 64} {i}", retries=retries
+            )
             latencies.append(time.perf_counter() - t0)
         except (asyncio.TimeoutError, TimeoutError):
+            errors.append(1)
+        except SupersededError:
+            # reply cache folded under a long storm before the client saw
+            # f+1 matches: an explicit NACK, not a latency sample
             errors.append(1)
         i += 1
 
@@ -90,6 +104,7 @@ async def run_config(
     qc_mode: bool = False,
     view_timeout: float = 0.0,
     chaos: dict = None,
+    max_crashes: int = 3,
 ) -> dict:
     from simple_pbft_tpu.committee import LocalCommittee
     from simple_pbft_tpu.crypto.tpu_verifier import BUCKETS, TpuVerifier
@@ -149,7 +164,17 @@ async def run_config(
         qc_mode=qc_mode,
     )
     for c in com.clients:
-        c.request_timeout = 30.0
+        # Storms: the first send of a request goes to a (possibly just
+        # crashed) primary and NOTHING reaches the committee until this
+        # timer triggers the broadcast retry — so it must be a small
+        # multiple of failover time, not a lazy 30 s (which was the
+        # entire tail of every storm p99). Steady-state benches keep the
+        # long timeout so retries never distort throughput numbers.
+        c.request_timeout = 1.5 * (view_timeout or 3.0) if storm else 30.0
+        if storm:
+            # hedged first sends: a crashing primary must not be the only
+            # holder of the in-flight batch (see client.Client.hedge)
+            c.hedge = 2
     com.start()
 
     latencies: List[float] = []
@@ -171,7 +196,7 @@ async def run_config(
         next_crash = t_start + seconds / 6
         while time.perf_counter() < stop_at - 1.0:
             await asyncio.sleep(0.2)
-            if time.perf_counter() >= next_crash and crashes < 3:
+            if time.perf_counter() >= next_crash and crashes < max_crashes:
                 view = max(r.view for r in com.replicas if r._running)
                 target = com.replica(com.cfg.primary(view))
                 if not target._running:
@@ -237,6 +262,11 @@ async def main() -> None:
     ap.add_argument("--outstanding", type=int, default=128)
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--storm", action="store_true")
+    ap.add_argument(
+        "--crashes", type=int, default=3,
+        help="storm: number of primary crash-stops (successive crashes "
+        "race each new view's first commit — the hardest variant)",
+    )
     ap.add_argument(
         "--chaos", default=None,
         help="fault injection for the run, e.g. drop=0.02,delay=0.03,"
@@ -304,6 +334,7 @@ async def main() -> None:
                 args.clients, args.outstanding, args.verifier, args.batch,
                 storm=True, view_timeout=args.view_timeout,
                 qc_mode=cfg.get("qc_mode", False), chaos=chaos,
+                max_crashes=args.crashes,
             )
         else:
             rec = await run_config(

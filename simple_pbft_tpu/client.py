@@ -44,6 +44,7 @@ class Client:
         transport: Transport,
         verifier: Optional[Verifier] = None,
         request_timeout: float = 1.0,
+        hedge: int = 0,
     ) -> None:
         self.id = client_id
         self.cfg = cfg
@@ -51,6 +52,14 @@ class Client:
         self.transport = transport
         self.verifier = verifier if verifier is not None else best_cpu_verifier()
         self.request_timeout = request_timeout
+        # Hedged first send: also deliver each request to `hedge` backups
+        # (rotating), who relay it to the primary and arm their failover
+        # timers on first receipt. Kills the worst-case failover tail
+        # where a crashing primary was the ONLY replica that knew about
+        # the in-flight batch — recovery then waits a full client
+        # request_timeout before anyone even suspects. Costs hedge+1
+        # sends per request instead of 1 (still O(1), not a broadcast).
+        self.hedge = hedge
         # microsecond wall-clock start (Castro-Liskov §2.4: client
         # timestamps are monotonic ACROSS restarts — a counter from 1
         # would leave a restarted client below the replicas' per-client
@@ -149,12 +158,19 @@ class Client:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._waiters[ts] = fut
         try:
-            # first attempt: primary only; afterwards: broadcast (classic
-            # PBFT retransmission — backups forward to the primary and arm
-            # view-change timers)
-            await self.transport.send(
-                self.cfg.primary(self.view_hint), raw
-            )
+            # first attempt: primary (+ hedged backups); afterwards:
+            # broadcast (classic PBFT retransmission — backups forward to
+            # the primary and arm view-change timers)
+            primary = self.cfg.primary(self.view_hint)
+            await self.transport.send(primary, raw)
+            if self.hedge:
+                ids = self.cfg.replica_ids
+                start = ids.index(primary) if primary in ids else 0
+                for k in range(self.hedge):
+                    # rotate targets per request so hedged load spreads
+                    rid = ids[(start + 1 + (ts + k) % (len(ids) - 1)) % len(ids)]
+                    if rid != primary:
+                        await self.transport.send(rid, raw)
             for attempt in range(retries + 1):
                 try:
                     # a SupersededError set on the future raises here

@@ -29,9 +29,11 @@ redesigned around its catalogued defects (SURVEY.md §2.9, §3.5):
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
+import threading
 import time
-from collections import defaultdict
+from collections import OrderedDict, defaultdict
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..app import Application, KVStore
@@ -152,6 +154,20 @@ class Replica:
         self._qc_sent: set = set()
         # (sender, view) -> count of failed-pairing QCs (DoS rate bound)
         self._qc_bad_by_sender: Dict[Tuple[str, int], int] = {}
+        # verified-GOOD signatures this replica has already checked, keyed
+        # (pubkey, sig, sha256(payload)) — the payload digest is part of
+        # the key so a replayed sig over different bytes never false-hits.
+        # The big win is failover: a NEW-VIEW embeds 2f+1 VIEW-CHANGEs
+        # the replica almost always verified individually moments before,
+        # so its verify batch shrinks from ~4f^2 signatures to the f+1
+        # genuinely new ones. Only positive verdicts are cached (a False
+        # must re-check: transient pubkey-config gaps must not stick).
+        # Lock: the ingest pipeline overlaps sweep k's verify with sweep
+        # k+1's, so two _timed_verify executor threads can touch the
+        # cache concurrently.
+        self._sig_cache: "OrderedDict[tuple, None]" = OrderedDict()
+        self._sig_cache_lock = threading.Lock()
+        self.SIG_CACHE_MAX = 16384
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -339,12 +355,40 @@ class Replica:
 
     def _timed_verify(self, items: List[BatchItem]) -> List[bool]:
         """Worker-thread wrapper: one verifier call, instrumented so
-        verifies/s and per-batch latency are observable (VERDICT weak #8)."""
+        verifies/s and per-batch latency are observable (VERDICT weak #8).
+        Already-verified signatures answer from the per-replica cache
+        (locked: the pipeline overlaps consecutive sweeps' verifies in
+        separate executor threads)."""
         t0 = time.perf_counter()
-        out = self.verifier.verify_batch(items)
+        out = [False] * len(items)
+        cache = self._sig_cache
+        fresh: List[BatchItem] = []
+        fresh_keys: List[Tuple[int, tuple]] = []
+        keys = [
+            (it.pubkey, it.sig, hashlib.sha256(it.msg).digest())
+            for it in items
+        ]
+        with self._sig_cache_lock:
+            for i, (it, key) in enumerate(zip(items, keys)):
+                if key in cache:
+                    cache.move_to_end(key)
+                    out[i] = True
+                else:
+                    fresh.append(it)
+                    fresh_keys.append((i, key))
+        if fresh:
+            verdicts = self.verifier.verify_batch(fresh)
+            with self._sig_cache_lock:
+                for (i, key), ok in zip(fresh_keys, verdicts):
+                    out[i] = bool(ok)
+                    if ok:
+                        cache[key] = None
+                while len(cache) > self.SIG_CACHE_MAX:
+                    cache.popitem(last=False)
+        self.metrics["sig_cache_hits"] += len(items) - len(fresh)
         dt = time.perf_counter() - t0
         self.stats.verify_ms.record(dt * 1e3)
-        self.stats.verify_items += len(items)
+        self.stats.verify_items += len(fresh)
         self.stats.verify_seconds += dt
         return out
 
@@ -413,12 +457,22 @@ class Replica:
                     )
                 )
         elif isinstance(msg, ViewChange):
-            # nested checkpoint + prepared certificates verify in the batch
-            res = validate_view_change(self.cfg, msg, current_view_floor=0)
-            if res is None:
-                return []
-            msg._validated = res  # skip re-validation in on_view_change
-            items.extend(res[2])
+            # Only the TARGET VIEW'S PRIMARY consumes a VIEW-CHANGE's
+            # nested certificates (to build its NEW-VIEW); backups use
+            # the message solely for the f+1 join rule and for counting
+            # toward the primary's quorum — envelope signature suffices
+            # (join counts authenticated senders; proofs are re-validated
+            # by every receiver inside the NEW-VIEW). Full validation at
+            # every backup measured ~40% of a 64-replica storm round's
+            # CPU (n^2 certificate walks on one host).
+            if self.cfg.primary(msg.new_view) == self.id:
+                res = validate_view_change(
+                    self.cfg, msg, current_view_floor=0
+                )
+                if res is None:
+                    return []
+                msg._validated = res  # skip re-validation in on_view_change
+                items.extend(res[2])
         elif isinstance(msg, NewView):
             res = validate_new_view(self.cfg, msg)
             if res is None:
@@ -435,7 +489,8 @@ class Replica:
         reqs: List[Request] = []
         for rd in block:
             try:
-                req = Message.from_dict(rd)
+                # the enclosing pre-prepare was depth-checked at from_wire
+                req = Message.from_dict(rd, _depth_checked=True)
             except ValueError:
                 return None
             if not isinstance(req, Request) or req.sender != req.client_id:

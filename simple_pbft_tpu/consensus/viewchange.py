@@ -67,7 +67,9 @@ def _decode(d: Any, want: type) -> Optional[Message]:
     if not isinstance(d, dict):
         return None
     try:
-        msg = Message.from_dict(d)
+        # certificate internals: the enclosing wire message was already
+        # depth-checked once on arrival (Message.from_wire)
+        msg = Message.from_dict(d, _depth_checked=True)
     except ValueError:
         return None
     return msg if isinstance(msg, want) else None
@@ -335,6 +337,7 @@ class ViewChanger:
         self._timer: Optional[asyncio.TimerHandle] = None
         self._vc_task: Optional[asyncio.Task] = None
         self._timeout = replica.cfg.view_timeout
+        self._nv_granted: set = set()  # views granted a NEW-VIEW window
 
     # -- timers ---------------------------------------------------------
 
@@ -464,16 +467,26 @@ class ViewChanger:
         )
 
     async def _verify_qcs(self, qcs) -> bool:
-        """Pairing-check the quorum certs embedded in a certificate,
-        off-loop, SEQUENTIALLY with early exit: a Byzantine certificate
-        stuffed with fabricated aggregates must cost one pairing, not
-        watermark_window of them (~0.8 s each, pure Python). Honest
-        certificates' QCs are memoized process-wide (consensus/qc.py) so
-        the sequential pass is one pairing per genuinely-new cert."""
-        for cert in qcs:
-            if not await asyncio.to_thread(qc_mod.verify_qc, self.r.cfg, cert):
-                return False
-        return True
+        """Pairing-check the quorum certs embedded in a certificate in
+        ONE worker-thread dispatch (a per-cert to_thread round-trip costs
+        an event-loop hop each — a NEW-VIEW carries up to 2f+1 certs and
+        failover is latency-critical). Inside the thread the loop stays
+        SEQUENTIAL with early exit: a Byzantine certificate stuffed with
+        fabricated aggregates must cost one pairing, not
+        watermark_window of them. Honest certificates' QCs are memoized
+        process-wide (consensus/qc.py) so the pass is one pairing per
+        genuinely-new cert."""
+        if not qcs:
+            return True
+        cfg = self.r.cfg
+
+        def run() -> bool:
+            for cert in qcs:
+                if not qc_mod.verify_qc(cfg, cert):
+                    return False
+            return True
+
+        return await asyncio.to_thread(run)
 
     # -- receiving ------------------------------------------------------
 
@@ -485,29 +498,55 @@ class ViewChanger:
         if msg.new_view > r.view + self.MAX_VIEWS_AHEAD:
             r.metrics["viewchange_too_far"] += 1
             return
+        # Full nested-certificate validation only where it is consumed:
+        # at the TARGET VIEW'S PRIMARY, whose O-set the proofs feed
+        # (normally pre-validated by the verify sweep; computed here for
+        # our own VC). Backups count the envelope-verified sender toward
+        # the join rule / primary quorum and validate the proofs inside
+        # the NEW-VIEW instead — full validation at all n replicas was an
+        # n^2 certificate walk that dominated storm-round CPU.
         res = getattr(msg, "_validated", None)
-        if res is None:
+        if res is None and r.cfg.primary(msg.new_view) == r.id:
             res = validate_view_change(r.cfg, msg, current_view_floor=r.view)
-        if res is None:
-            r.metrics["bad_viewchange"] += 1
-            return
-        if not await self._verify_qcs(res[3]):
-            r.metrics["bad_viewchange_qc"] += 1
-            return
+            if res is None:
+                r.metrics["bad_viewchange"] += 1
+                return
+        if res is not None:
+            if not await self._verify_qcs(res[3]):
+                r.metrics["bad_viewchange_qc"] += 1
+                return
         store = self.vc_store.setdefault(msg.new_view, {})
         store[msg.sender] = msg
-        # adopt the highest checkpoint the committee proves (state catch-up)
-        _, cps, _, vqcs = res
-        for cp in cps:
-            await r.on_checkpoint_msg(cp)
-        for cert in vqcs:
-            # checkpoint aggregates were pairing-verified above: adopt for
-            # our OWN future VIEW-CHANGEs (we may never see the individual
-            # checkpoint votes) and stabilize, fetching state from the
-            # aggregate's signers
-            if cert.phase == "checkpoint":
-                r.checkpoint_qcs.setdefault(cert.seq, cert)
-                await r._stabilize(cert.seq, cert.digest, list(cert.signers))
+        # The 2f+1th VIEW-CHANGE for our target just landed: only NOW can
+        # the new primary even begin building its NEW-VIEW, so grant it a
+        # fresh (backed-off) window. Without this the clock that started
+        # at our own timer expiry keeps running through the whole
+        # collect-certify-install pipeline, and at sizes where that takes
+        # longer than the base timeout every first attempt tears itself
+        # down and the committee climbs the backoff ladder (measured:
+        # one crash at n=64/QC -> views 1..4 all rejected below-target,
+        # p99 = the full 3+6+12+24 s ladder).
+        if (
+            self.in_view_change
+            and msg.new_view == self.target_view
+            and len(store) == r.cfg.quorum
+        ):
+            self._rearm_only()
+        if res is not None:
+            # adopt the highest checkpoint the certificate proves (state
+            # catch-up; backups get the same adoption from the NEW-VIEW's
+            # embedded certificates, on_new_view)
+            _, cps, _, vqcs = res
+            for cp in cps:
+                await r.on_checkpoint_msg(cp)
+            for cert in vqcs:
+                # checkpoint aggregates were pairing-verified above: adopt
+                # for our OWN future VIEW-CHANGEs (we may never see the
+                # individual checkpoint votes) and stabilize, fetching
+                # state from the aggregate's signers
+                if cert.phase == "checkpoint":
+                    r.checkpoint_qcs.setdefault(cert.seq, cert)
+                    await r._stabilize(cert.seq, cert.digest, list(cert.signers))
 
         # liveness: f+1 replicas moving past us -> join the lowest such view
         if not self.in_view_change or msg.new_view > self.target_view:
@@ -547,6 +586,11 @@ class ViewChanger:
             pre_prepares=pre_prepares,
         )
         r.signer.sign_msg(nv)
+        # self-install below must not re-validate the certificate we just
+        # assembled from individually-validated VCs (their QCs are
+        # pairing-verified and memoized; re-walking 2f+1 nested proofs
+        # measured ~2 s of the failover critical path at n=64)
+        nv._validated = (vcs, [], [])
         self.new_view_sent.add(new_view)
         r.metrics["new_views_sent"] += 1
         nv_wire = nv.to_wire()
@@ -570,6 +614,19 @@ class ViewChanger:
         r = self.r
         if msg.new_view <= r.view:
             return
+        if (
+            msg.sender == r.cfg.primary(msg.new_view)
+            and msg.new_view not in self._nv_granted
+        ):
+            # the NEW-VIEW for a pending view just arrived (authenticated
+            # sender): give its validation+install pipeline one fresh
+            # (backed-off) window instead of letting a timer that started
+            # at our own expiry tear down an install already in flight.
+            # Once per view — a Byzantine primary can't stack grants.
+            self._nv_granted = {
+                v for v in self._nv_granted if v > r.view
+            } | {msg.new_view}
+            self._rearm_only()
         if self.in_view_change and msg.new_view < self.target_view:
             # we already promised a later view — our outstanding
             # VIEW-CHANGE freezes prepared state for target_view; rejoining
@@ -613,8 +670,21 @@ class ViewChanger:
         # Resetting on install lets a slow-but-correct view (e.g. QC
         # pairing latency > base timeout) be torn down forever: install,
         # re-arm at base, expire before the first commit, repeat — a
-        # self-inflicted view-change storm. Castro-Liskov doubles per
-        # attempt and resets on completed requests only.
+        # self-inflicted view-change storm; keeping the attempt-doubling
+        # ladder (start_view_change) un-reset preserves escalation for
+        # chronically slow views. The post-install window does get a
+        # FLOOR of 3x base: install is real progress, but the round
+        # isn't safe until the first commit, and the post-install
+        # pipeline (relay adoption, re-proposals, a full QC round
+        # through congested queues) routinely outlives the base window —
+        # early installers expiring just before the first commit tore
+        # down healthy views (measured at n=64: install t+0.1, expiry
+        # t+6.0, first commit t+6.5). A floor (not a doubling: that
+        # compounded into 48 s windows across back-to-back crashes)
+        # bounds consecutive-crash recovery while still covering the
+        # pipeline.
+        base = r.cfg.view_timeout
+        self._timeout = min(max(self._timeout, 3 * base), 60.0)
         self._rearm_only()
         r.metrics["views_installed"] += 1
         # old views' QC-sender mute counters are moot once the view moves;
