@@ -60,6 +60,11 @@ class Client:
         # request_timeout before anyone even suspects. Costs hedge+1
         # sends per request instead of 1 (still O(1), not a broadcast).
         self.hedge = hedge
+        # per-replica MAC keys: replies carry an HMAC tag instead of a
+        # signature when both ends publish kx keys (crypto/mac.py)
+        from .crypto import mac as mac_mod
+
+        self._mac = mac_mod.MacBank(seed, cfg.kx_pubkeys)
         # microsecond wall-clock start (Castro-Liskov §2.4: client
         # timestamps are monotonic ACROSS restarts — a counter from 1
         # would leave a restarted client below the replicas' per-client
@@ -106,18 +111,33 @@ class Client:
                 # otherwise pays n-(f+1) wasted verifies per request
                 continue
             if self.cfg.verify_signatures:
-                pub = self.cfg.pubkey(msg.sender)
-                if pub is None or not msg.sig:
-                    continue
-                try:
-                    sig = bytes.fromhex(msg.sig)
-                except ValueError:
-                    continue
-                ok = self.verifier.verify_batch(
-                    [BatchItem(pubkey=pub, msg=msg.signing_payload(), sig=sig)]
-                )
-                if not ok[0]:
-                    continue
+                if msg.mac:
+                    # point-to-point fast path: HMAC under the shared key
+                    # with the claimed sender (crypto/mac.py)
+                    from .crypto import mac as mac_mod
+
+                    key = self._mac.key_for(msg.sender)
+                    if key is None or not mac_mod.tag_valid(
+                        key, msg.signing_payload(), msg.mac
+                    ):
+                        continue
+                else:
+                    pub = self.cfg.pubkey(msg.sender)
+                    if pub is None or not msg.sig:
+                        continue
+                    try:
+                        sig = bytes.fromhex(msg.sig)
+                    except ValueError:
+                        continue
+                    ok = self.verifier.verify_batch(
+                        [
+                            BatchItem(
+                                pubkey=pub, msg=msg.signing_payload(), sig=sig
+                            )
+                        ]
+                    )
+                    if not ok[0]:
+                        continue
             self._on_reply(msg)
 
     def _on_reply(self, msg: Reply) -> None:

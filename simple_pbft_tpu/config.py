@@ -34,6 +34,10 @@ class CommitteeConfig:
     # 2f+1 embedded votes.
     qc_mode: bool = False
     bls_pubkeys: Dict[str, bytes] = field(default_factory=dict)  # 192-byte G2
+    # X25519 key-exchange pubkeys (replicas AND clients) for the MAC'd
+    # reply fast path (crypto/mac.py); pairs lacking either key fall
+    # back to Ed25519-signed replies
+    kx_pubkeys: Dict[str, bytes] = field(default_factory=dict)
 
     @property
     def n(self) -> int:
@@ -94,10 +98,16 @@ def make_test_committee(
 
         for rid in ids:
             _, bls_pubkeys[rid] = bls.keygen(keys[rid].seed)
+    from .crypto import mac as mac_mod
+
     cfg = CommitteeConfig(
         replica_ids=ids,
         pubkeys={k: v.pub for k, v in keys.items()},
         bls_pubkeys=overrides.pop("bls_pubkeys", bls_pubkeys),
+        kx_pubkeys=overrides.pop(
+            "kx_pubkeys",
+            {k: mac_mod.kx_pubkey(v.seed) for k, v in keys.items()},
+        ),
         **overrides,
     )
     return cfg, keys

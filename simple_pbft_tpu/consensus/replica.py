@@ -168,6 +168,30 @@ class Replica:
         self._sig_cache: "OrderedDict[tuple, None]" = OrderedDict()
         self._sig_cache_lock = threading.Lock()
         self.SIG_CACHE_MAX = 16384
+        # position in the committee ring (designated-replier rotation)
+        self._index = cfg.replica_ids.index(node_id)
+        # per-client MAC keys for the point-to-point reply fast path
+        from ..crypto import mac as mac_mod
+
+        self._mac = mac_mod.MacBank(seed, cfg.kx_pubkeys)
+
+    def _auth_reply(self, reply: Reply) -> None:
+        """Authenticate a reply: per-client HMAC when BOTH ends publish kx
+        keys (~2 us) — the client derives the same key from OUR published
+        kx pubkey, so a replica absent from kx_pubkeys must sign instead
+        or its MAC'd replies are undecipherable. Ed25519 otherwise."""
+        from ..crypto import mac as mac_mod
+
+        key = (
+            self._mac.key_for(reply.client_id)
+            if self.id in self.cfg.kx_pubkeys
+            else None
+        )
+        if key is not None:
+            reply.sender = self.id
+            reply.mac = mac_mod.tag(key, reply.signing_payload())
+        else:
+            self.signer.sign_msg(reply)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -401,7 +425,13 @@ class Replica:
             bitmap = await verify_task if verify_task is not None else []
             accepted = []
             for msg, (s, e) in zip(decoded, spans):
-                if e > s and all(bitmap[s:e]):
+                if s == e:
+                    # structurally inadmissible or redundant (no signature
+                    # items were even collected) — NOT a forged signature;
+                    # keeping bad_sig clean of these preserves it as the
+                    # Byzantine-signature alarm
+                    self.metrics["dropped_precheck"] += 1
+                elif all(bitmap[s:e]):
                     accepted.append(msg)
                 else:
                     self.metrics["bad_sig"] += 1
@@ -434,6 +464,18 @@ class Replica:
             # a client only speaks for itself (relayed requests keep the
             # original client signature, so sender stays the client)
             if msg.sender != msg.client_id:
+                return []
+        if isinstance(msg, (Prepare, Commit)):
+            # the instance already has its quorum for this phase: the
+            # vote is redundant — verifying the straggler (n - 2f - 1)
+            # votes per phase was ~a third of the O(n^2) vote work at
+            # n=100. Only post-quorum arrivals are dropped, so a vote
+            # flood can't crowd honest votes out of quorum formation.
+            inst = self.instances.get((msg.view, msg.seq))
+            if inst is not None and (
+                inst.committed() if isinstance(msg, Commit) else inst.prepared()
+            ):
+                self.metrics["redundant_votes_dropped"] += 1
                 return []
         pub = self.cfg.pubkey(msg.sender)
         if pub is None or not msg.sig:
@@ -563,6 +605,9 @@ class Replica:
             # duplicate: re-send the cached reply if we already executed it
             cached = recent.get(req.timestamp)
             if cached is not None:
+                if not cached.sig and not cached.mac:
+                    # cached by a non-designated replier: authenticate now
+                    self._auth_reply(cached)
                 await self.transport.send(req.client_id, cached.to_wire())
             elif key in self.relay_buffer or key in self.seen_requests:
                 # client is retrying something still unexecuted: the
@@ -873,11 +918,21 @@ class Replica:
                     timestamp=req.timestamp,
                     result=result,
                 )
-                self.signer.sign_msg(reply)
                 self.recent_replies.setdefault(req.client_id, {})[
                     req.timestamp
                 ] = reply
-                await self.transport.send(req.client_id, reply.to_wire())
+                # Designated repliers: exactly f+1 replicas (rotating by
+                # seq) sign and transmit — f+1 matching is all the client
+                # can use, so the other n-f-1 signatures and sends were
+                # pure waste (at n=100: 66 signs + 66 client-side decodes
+                # per request). Everyone still CACHES the reply: if a
+                # designated replier is faulty or slow, the client's
+                # retransmission hits the _on_request duplicate branch,
+                # where every replica signs-on-demand and resends the
+                # cached reply (the liveness fallback).
+                if (self._index - act.seq) % self.cfg.n < self.cfg.weak_quorum:
+                    self._auth_reply(reply)
+                    await self.transport.send(req.client_id, reply.to_wire())
             if self.executed_seq % self.cfg.checkpoint_interval == 0:
                 await self._emit_checkpoint(self.executed_seq)
             self.vc.reset()  # commits are progress: the primary is alive
@@ -894,7 +949,7 @@ class Replica:
             timestamp=req.timestamp,
             superseded=1,
         )
-        self.signer.sign_msg(reply)
+        self._auth_reply(reply)
         await self.transport.send(req.client_id, reply.to_wire())
 
     # ------------------------------------------------------------------
@@ -922,7 +977,8 @@ class Replica:
                 "replies": {
                     c: {
                         str(ts): {
-                            **r.to_dict(), "sender": "", "sig": "", "view": 0,
+                            **r.to_dict(),
+                            "sender": "", "sig": "", "mac": "", "view": 0,
                         }
                         for ts, r in sorted(recent.items())
                     }

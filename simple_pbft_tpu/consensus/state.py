@@ -86,6 +86,20 @@ class Instance:
     prepare_qc: Optional[Any] = None  # verified QuorumCert(phase=prepare)
     commit_qc: Optional[Any] = None
     t_started: float = 0.0  # perf_counter at pre-prepare admission (stats)
+    # incremental counts of votes matching the fixed digest — counting
+    # the logs on every arrival was O(n) per vote = O(n^2) per slot per
+    # replica (measured ~7% of an n=100 committee's CPU)
+    _prep_matching: int = 0
+    _com_matching: int = 0
+
+    def _recount_matching(self) -> None:
+        """Digest just became fixed: count the buffered early votes."""
+        self._prep_matching = sum(
+            1 for v in self.prepares.values() if v.digest == self.digest
+        )
+        self._com_matching = sum(
+            1 for v in self.commits.values() if v.digest == self.digest
+        )
 
     # -- phase inputs -------------------------------------------------------
 
@@ -111,7 +125,9 @@ class Instance:
         if PrePrepare.block_digest(msg.block) != msg.digest:
             return []  # digest mismatch — mirrors verifyMsg digest check
         self.pre_prepare = msg
-        self.digest = msg.digest
+        if self.digest is None:
+            self.digest = msg.digest
+            self._recount_matching()
         self.block = msg.block
         if self.stage == Stage.IDLE:
             self.stage = Stage.PRE_PREPARED
@@ -130,6 +146,8 @@ class Instance:
         if msg.sender in self.prepares:
             return []  # duplicate sender
         self.prepares[msg.sender] = msg
+        if self.digest is not None and msg.digest == self.digest:
+            self._prep_matching += 1
         return self._maybe_advance()
 
     def on_commit(self, msg: Commit) -> List[Action]:
@@ -141,6 +159,8 @@ class Instance:
         if msg.sender in self.commits:
             return []
         self.commits[msg.sender] = msg
+        if self.digest is not None and msg.digest == self.digest:
+            self._com_matching += 1
         return self._maybe_advance()
 
     # -- quorum predicates --------------------------------------------------
@@ -149,17 +169,12 @@ class Instance:
         """Reference: prepared() pbft_impl.go:207-217."""
         return (
             self.pre_prepare is not None
-            and self._votes(self.prepares) >= self.quorum
+            and self._prep_matching >= self.quorum
         )
 
     def committed(self) -> bool:
         """Reference: committed() pbft_impl.go:222-232."""
-        return self.prepared() and self._votes(self.commits) >= self.quorum
-
-    def _votes(self, log: Dict[str, Any]) -> int:
-        if self.digest is None:
-            return 0
-        return sum(1 for v in log.values() if v.digest == self.digest)
+        return self.prepared() and self._com_matching >= self.quorum
 
     # -- transitions --------------------------------------------------------
 
@@ -199,6 +214,7 @@ class Instance:
         self.prepare_qc = qc
         if self.digest is None:
             self.digest = qc.digest
+            self._recount_matching()
         return self._maybe_advance_qc()
 
     def on_commit_qc(self, qc) -> List[Action]:
@@ -211,6 +227,7 @@ class Instance:
         self.commit_qc = qc
         if self.digest is None:
             self.digest = qc.digest
+            self._recount_matching()
         return self._maybe_advance_qc()
 
     def _maybe_advance_qc(self) -> List[Action]:

@@ -332,7 +332,10 @@ class ViewChanger:
         self.r = replica
         self.in_view_change = False
         self.target_view = replica.view
-        self.vc_store: Dict[int, Dict[str, ViewChange]] = {}
+        # view -> sender -> full validated ViewChange at that view's
+        # primary; None at backups (sender presence is all the join rule
+        # and quorum counting need — see on_view_change)
+        self.vc_store: Dict[int, Dict[str, Optional[ViewChange]]] = {}
         self.new_view_sent: set = set()
         self._timer: Optional[asyncio.TimerHandle] = None
         self._vc_task: Optional[asyncio.Task] = None
@@ -516,7 +519,12 @@ class ViewChanger:
                 r.metrics["bad_viewchange_qc"] += 1
                 return
         store = self.vc_store.setdefault(msg.new_view, {})
-        store[msg.sender] = msg
+        # Backups keep only the SENDER (join counting) — retaining the
+        # unvalidated body would let one Byzantine replica park
+        # MAX_VIEWS_AHEAD x 64 MiB of junk prepared_proofs per backup.
+        # The target view's primary keeps the full (validated) message:
+        # its NEW-VIEW is assembled from exactly these.
+        store[msg.sender] = msg if res is not None else None
         # The 2f+1th VIEW-CHANGE for our target just landed: only NOW can
         # the new primary even begin building its NEW-VIEW, so grant it a
         # fresh (backed-off) window. Without this the clock that started

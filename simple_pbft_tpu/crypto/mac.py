@@ -1,0 +1,98 @@
+"""Point-to-point MAC authentication for client replies.
+
+Castro-Liskov PBFT authenticates most messages with MAC vectors and
+reserves digital signatures for messages that need third-party
+verifiability (view changes). This framework keeps Ed25519 signatures on
+everything that enters certificates or blocks — those are what the TPU
+verifier batches — but a REPLY is consumed by exactly one party (the
+requesting client), so a per-(replica, client) MAC authenticates it at
+~2 us instead of a 34 us sign + 114 us verify. At n=100 that removes 66
+signs and f+1 client-side verifies per request from the hot path.
+
+Keys: X25519 Diffie-Hellman between deterministic per-node key-exchange
+keys (derived from each node's 32-byte seed under a dedicated domain
+label, so the Ed25519 identity seed never doubles as a DH key), then
+HKDF-style SHA-256 extraction. The committee config publishes
+``kx_pubkeys``; a pair lacking either key transparently falls back to
+Ed25519-signed replies.
+
+Threat model parity with signed replies: a MAC authenticates the replica
+to the client exactly as a signature does (the client trusts its OWN
+shared key with that replica); a Byzantine replica can forge only its
+own replies in both schemes. Replies never need third-party audit — the
+client alone matches f+1 of them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+from typing import Dict, Optional
+
+_KX_DOMAIN = b"simple_pbft_tpu/kx-v1"
+
+
+def _kx_priv_bytes(seed: bytes) -> bytes:
+    """Deterministic X25519 private key bytes from a node seed (domain-
+    separated from the Ed25519 identity derivation)."""
+    return hashlib.sha256(_KX_DOMAIN + seed).digest()
+
+
+def kx_pubkey(seed: bytes) -> bytes:
+    """32-byte X25519 public key for a node's key-exchange identity."""
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+
+    priv = X25519PrivateKey.from_private_bytes(_kx_priv_bytes(seed))
+    return priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+
+
+def shared_key(seed: bytes, peer_kx_pub: bytes) -> Optional[bytes]:
+    """HKDF-extracted 32-byte MAC key for (this node, peer). None if the
+    peer key is structurally invalid."""
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+
+    try:
+        priv = X25519PrivateKey.from_private_bytes(_kx_priv_bytes(seed))
+        secret = priv.exchange(X25519PublicKey.from_public_bytes(peer_kx_pub))
+    except Exception:  # malformed peer key: caller falls back to signatures
+        return None
+    return hmac.new(_KX_DOMAIN, secret, hashlib.sha256).digest()
+
+
+def tag(key: bytes, payload: bytes) -> str:
+    """Hex HMAC-SHA256 tag."""
+    return hmac.new(key, payload, hashlib.sha256).hexdigest()
+
+
+def tag_valid(key: bytes, payload: bytes, tag_hex: str) -> bool:
+    try:
+        expect = hmac.new(key, payload, hashlib.sha256).hexdigest()
+        return hmac.compare_digest(expect, tag_hex)
+    except Exception:
+        return False
+
+
+class MacBank:
+    """Per-node cache of shared MAC keys (one DH per peer, on demand)."""
+
+    def __init__(self, seed: bytes, kx_pubkeys: Dict[str, bytes]) -> None:
+        self._seed = seed
+        self._kx_pubkeys = kx_pubkeys
+        self._keys: Dict[str, Optional[bytes]] = {}
+
+    def key_for(self, peer_id: str) -> Optional[bytes]:
+        if peer_id not in self._keys:
+            pub = self._kx_pubkeys.get(peer_id)
+            self._keys[peer_id] = (
+                shared_key(self._seed, pub) if pub is not None else None
+            )
+        return self._keys[peer_id]

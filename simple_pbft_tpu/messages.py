@@ -23,7 +23,7 @@ from __future__ import annotations
 import hashlib
 import json
 from dataclasses import dataclass, field, fields
-from typing import Any, ClassVar, Dict, List, Optional, Type
+from typing import Any, ClassVar, Dict, List, Optional, Tuple, Type
 
 # ---------------------------------------------------------------------------
 # Canonical encoding helpers
@@ -78,11 +78,15 @@ class Message:
 
     def __setattr__(self, name: str, value: Any) -> None:
         # any public-field mutation invalidates the cached signing
-        # payload (below) — except ``sig``, which the payload blanks by
-        # construction (so signing a message keeps its own cache warm)
-        if name != "sig" and not name.startswith("_"):
-            self.__dict__.pop("_payload", None)
-        object.__setattr__(self, name, value)
+        # payload (below) — except the authenticator fields ``sig`` and
+        # ``mac``, which every payload blanks by construction (so
+        # signing/tagging a message keeps its own cache warm). Fast path
+        # first: during dataclass __init__ no cache exists yet, and this
+        # runs per field per decoded message on the hot path.
+        d = self.__dict__
+        if "_payload" in d and name != "sig" and name != "mac" and name[0] != "_":
+            del d["_payload"]
+        d[name] = value
 
     # -- serialization ------------------------------------------------------
 
@@ -197,25 +201,37 @@ class Message:
             d = json.loads(raw)
         except (json.JSONDecodeError, UnicodeDecodeError, RecursionError) as e:
             raise ValueError(f"undecodable message: {e}") from None
-        msg = Message.from_dict(d)
+        # The nesting walk exists to keep later canonical re-encodes
+        # (signing/digest paths) clear of the C encoder's ~1000-frame
+        # recursion limit. JSON depth is bounded by bytes/2, so frames
+        # this small can't get near it — skip the walk on the hot path
+        # (typed field validation in _build still applies in full).
+        msg = Message.from_dict(d, _depth_checked=len(raw) <= 1500)
         if len(raw) > type(msg).MAX_WIRE_BYTES:
             raise ValueError("message too large for its type")
         return msg
 
     # -- signing ------------------------------------------------------------
 
+    #: authenticator fields blanked out of every signing payload (a tag
+    #: cannot cover itself); subclasses with additional authenticators
+    #: extend this (Reply adds "mac") — __setattr__'s invalidation
+    #: exemptions must stay in sync with the union of these.
+    _AUTH_FIELDS: ClassVar[Tuple[str, ...]] = ("sig",)
+
     def signing_payload(self) -> bytes:
-        """Canonical encoding with the sig field blanked.
+        """Canonical encoding with the authenticator fields blanked.
 
         Cached after first computation and invalidated by __setattr__ on
-        any payload-relevant field mutation. The cache is sig-independent
-        by construction (sig is blanked) and a NEW-VIEW's 2f+1 embedded
+        any payload-relevant field mutation. The cache is authenticator-
+        independent by construction, and a NEW-VIEW's 2f+1 embedded
         certificates re-canonicalizing at every receiver measured ~10%
         of a storm's CPU."""
         cached = self.__dict__.get("_payload")
         if cached is None:
             d = self.to_dict()
-            d["sig"] = ""
+            for f_ in self._AUTH_FIELDS:
+                d[f_] = ""
             cached = canonical_json(d)
             self.__dict__["_payload"] = cached
         return cached
@@ -274,6 +290,14 @@ class Reply(Message):
     #: an in-band reserved result string — nothing stops an application
     #: from legitimately storing/returning any string.
     superseded: int = 0
+    #: hex HMAC-SHA256 over signing_payload() under the per-(replica,
+    #: client) shared key (crypto/mac.py) — the point-to-point fast path;
+    #: either ``mac`` or ``sig`` authenticates a reply, never both needed.
+    mac: str = ""
+
+    #: both authenticators blank out of the payload so sig and mac attest
+    #: the same bytes and either can authenticate interchangeably
+    _AUTH_FIELDS: ClassVar[Tuple[str, ...]] = ("sig", "mac")
 
 
 # ---------------------------------------------------------------------------

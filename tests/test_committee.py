@@ -192,7 +192,9 @@ def test_unsigned_traffic_rejected():
         finally:
             await com.stop()
         assert all(r.metrics["committed_requests"] == 0 for r in com.replicas)
-        assert com.replica("r0").metrics["bad_sig"] >= 1
+        # unsigned request = no signature items collected -> precheck drop
+        assert com.replica("r0").metrics["dropped_precheck"] >= 1
+        assert com.replica("r1").metrics["dropped_precheck"] >= 1
 
     run(scenario())
 
@@ -252,7 +254,10 @@ def test_client_keys_cannot_join_quorums():
             await com.stop()
         r1 = com.replica("r1")
         assert r1.metrics["committed_blocks"] == 0
-        assert r1.metrics["bad_sig"] >= 4  # the forged client votes
+        # client-keyed votes are a ROLE violation: rejected before any
+        # signature items are collected (bad_sig stays a pure forged-
+        # signature alarm)
+        assert r1.metrics["dropped_precheck"] >= 4
 
     run(scenario())
 
@@ -355,7 +360,10 @@ def test_committee_over_tpu_verifier():
             from simple_pbft_tpu.messages import Commit
 
             r0 = com.replica("r0")
-            forged = Commit(view=0, seq=1, digest="f" * 64)
+            # target a not-yet-quorate slot: votes for already-committed
+            # seqs are dropped pre-verification as redundant (and thus
+            # never reach the forged-signature alarm)
+            forged = Commit(view=0, seq=200, digest="f" * 64)
             Signer("r1", com.keys["r2"].seed).sign_msg(forged)
             forged.sender = "r1"
             await com.net.endpoint("r2").send("r0", forged.to_wire())
