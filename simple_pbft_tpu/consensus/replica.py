@@ -466,17 +466,30 @@ class Replica:
             if msg.sender != msg.client_id:
                 return []
         if isinstance(msg, (Prepare, Commit)):
-            # the instance already has its quorum for this phase: the
-            # vote is redundant — verifying the straggler (n - 2f - 1)
-            # votes per phase was ~a third of the O(n^2) vote work at
-            # n=100. Only post-quorum arrivals are dropped, so a vote
-            # flood can't crowd honest votes out of quorum formation.
+            # the instance already has this phase settled: the vote is
+            # redundant — verifying the straggler (n - 2f - 1) votes per
+            # phase was ~a third of the O(n^2) vote work at n=100. Only
+            # post-quorum arrivals are dropped, so a vote flood can't
+            # crowd honest votes out of quorum formation. In QC mode
+            # "settled" means the phase's aggregate EXISTS: a vote-count
+            # quorum is not enough, because a poisoned share bisected
+            # out of the first 2f+1 means the primary still needs the
+            # late stragglers' shares to rebuild the aggregate.
             inst = self.instances.get((msg.view, msg.seq))
-            if inst is not None and (
-                inst.committed() if isinstance(msg, Commit) else inst.prepared()
-            ):
-                self.metrics["redundant_votes_dropped"] += 1
-                return []
+            if inst is not None:
+                if self.cfg.qc_mode:
+                    settled = (
+                        inst.commit_qc if isinstance(msg, Commit)
+                        else inst.prepare_qc
+                    ) is not None
+                else:
+                    settled = (
+                        inst.committed() if isinstance(msg, Commit)
+                        else inst.prepared()
+                    )
+                if settled:
+                    self.metrics["redundant_votes_dropped"] += 1
+                    return []
         pub = self.cfg.pubkey(msg.sender)
         if pub is None or not msg.sig:
             return []

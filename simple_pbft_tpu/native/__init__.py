@@ -29,6 +29,8 @@ _SRC = os.path.join(os.path.dirname(__file__), "pbft_native.cpp")
 _SO = os.path.join(os.path.dirname(__file__), "_pbft_native.so")
 _SRC_BLS = os.path.join(os.path.dirname(__file__), "bls381.cpp")
 _SO_BLS = os.path.join(os.path.dirname(__file__), "_bls381.so")
+_SRC_ED = os.path.join(os.path.dirname(__file__), "ed25519.cpp")
+_SO_ED = os.path.join(os.path.dirname(__file__), "_ed25519.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -38,6 +40,9 @@ _tried = False
 _bls_lock = threading.Lock()
 _bls_lib: Optional[ctypes.CDLL] = None
 _bls_tried = False
+_ed_lock = threading.Lock()
+_ed_lib: Optional[ctypes.CDLL] = None
+_ed_tried = False
 
 _u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
 _i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
@@ -146,6 +151,77 @@ def _load_bls() -> Optional[ctypes.CDLL]:
             return None
         _bls_lib = lib
         return _bls_lib
+
+
+def _load_ed() -> Optional[ctypes.CDLL]:
+    """Loader for the batch Ed25519 verifier (ed25519.cpp) — same
+    build-on-demand + Python-fallback contract as the other libraries."""
+    global _ed_lib, _ed_tried
+    with _ed_lock:
+        if _ed_tried:
+            return _ed_lib
+        _ed_tried = True
+        try:
+            fresh = os.path.exists(_SO_ED) and (
+                os.path.getmtime(_SO_ED) >= os.path.getmtime(_SRC_ED)
+            )
+        except OSError:
+            fresh = os.path.exists(_SO_ED)
+        if not fresh and not _build_so(_SRC_ED, _SO_ED):
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_ED)
+        except OSError as e:
+            log.warning("ed25519 load failed: %s — using fallback", e)
+            return None
+        try:
+            _i32p = np.ctypeslib.ndpointer(
+                dtype=np.int32, flags="C_CONTIGUOUS"
+            )
+            lib.ed25519_batch_verify.argtypes = [
+                _u8p, ctypes.c_int, _i32p, _u8p, _u8p, _u8p, _u8p, _u8p,
+                ctypes.c_int,
+            ]
+            lib.ed25519_batch_verify.restype = ctypes.c_int
+        except AttributeError as e:
+            log.warning("ed25519 stale/incomplete: %s — fallback", e)
+            return None
+        _ed_lib = lib
+        return _ed_lib
+
+
+def ed25519_available() -> bool:
+    return _load_ed() is not None
+
+
+def ed25519_batch_verify(
+    a_xy: np.ndarray,       # (n_keys, 64) uint8: affine x||y, 32B LE each
+    key_idx: np.ndarray,    # (B,) int32 into a_xy (-1 = invalid key)
+    s_scalars: np.ndarray,  # (B, 32) uint8, already range-checked < L
+    k_scalars: np.ndarray,  # (B, 32) uint8, SHA-512(R||A||M) mod L
+    r_wire: np.ndarray,     # (B, 32) uint8, signature R wire bytes
+    precheck: np.ndarray,   # (B,) uint8 validity mask
+) -> Optional[np.ndarray]:
+    """Batched [S]B + [k](-A) == R verification; None = unavailable."""
+    lib = _load_ed()
+    if lib is None:
+        return None
+    batch = len(key_idx)
+    out = np.zeros(batch, dtype=np.uint8)
+    rc = lib.ed25519_batch_verify(
+        np.ascontiguousarray(a_xy, dtype=np.uint8),
+        len(a_xy),
+        np.ascontiguousarray(key_idx, dtype=np.int32),
+        np.ascontiguousarray(s_scalars, dtype=np.uint8),
+        np.ascontiguousarray(k_scalars, dtype=np.uint8),
+        np.ascontiguousarray(r_wire, dtype=np.uint8),
+        np.ascontiguousarray(precheck, dtype=np.uint8),
+        out,
+        batch,
+    )
+    if rc != 0:
+        return None
+    return out
 
 
 def _cbuf(b: bytes):
