@@ -103,30 +103,44 @@ class NativeEdVerifier:
             raise ImportError("native ed25519 library unavailable")
         self._native = native
         self._np = np
-        # pubkey bytes -> (index into the affine bank) | None (bad point)
+        # pubkey bytes -> (index into the affine bank) | None (bad point).
+        # Locked: the replica pipeline overlaps consecutive sweeps'
+        # verifies in separate executor threads, and an unlocked
+        # check-then-append could permanently map one key to another's
+        # bank row (every later signature from it failing).
+        import threading
+
+        self._key_lock = threading.Lock()
         self._key_index: dict = {}
         self._bank_rows: list = []  # (64,) uint8 rows: x||y little-endian
 
     def _key_for(self, pubkey: bytes):
-        idx = self._key_index.get(pubkey)
-        if idx is None and pubkey not in self._key_index:
-            pt = (
-                ed25519_cpu.point_decompress(pubkey)
-                if len(pubkey) == 32
-                else None
+        with self._key_lock:
+            if pubkey in self._key_index:
+                return self._key_index[pubkey]
+        # decompression (exact bigint math) runs outside the lock; a
+        # racing duplicate computation is harmless, the insert re-checks
+        pt = (
+            ed25519_cpu.point_decompress(pubkey)
+            if len(pubkey) == 32
+            else None
+        )
+        if pt is None:
+            row = None
+        else:
+            x, y = ed25519_cpu.point_to_affine(pt)
+            row = self._np.frombuffer(
+                x.to_bytes(32, "little") + y.to_bytes(32, "little"),
+                dtype=self._np.uint8,
             )
-            if pt is None:
-                idx = None
-            else:
-                x, y = ed25519_cpu.point_to_affine(pt)
-                row = self._np.frombuffer(
-                    x.to_bytes(32, "little") + y.to_bytes(32, "little"),
-                    dtype=self._np.uint8,
-                )
-                idx = len(self._bank_rows)
-                self._bank_rows.append(row)
-            self._key_index[pubkey] = idx
-        return idx
+        with self._key_lock:
+            if pubkey not in self._key_index:
+                if row is None:
+                    self._key_index[pubkey] = None
+                else:
+                    self._key_index[pubkey] = len(self._bank_rows)
+                    self._bank_rows.append(row)
+            return self._key_index[pubkey]
 
     def verify_batch(self, items: Sequence[BatchItem]) -> List[bool]:
         np = self._np

@@ -69,124 +69,108 @@ def _build_so(src: str, so: str, extra=()) -> bool:
         return False
 
 
-def _build() -> bool:
-    return _build_so(_SRC, _SO, extra=("-fopenmp",))
+def _load_library(src: str, so: str, configure, extra=()) -> Optional[ctypes.CDLL]:
+    """Shared build-on-demand loader: rebuild when the source is newer
+    (tolerating a missing source by using the cached .so), CDLL-load,
+    then run ``configure(lib)`` (argtypes + optional selftest; raise
+    AttributeError for stale exports, return None to reject). Any
+    failure degrades to the caller's Python fallback."""
+    name = os.path.basename(so)
+    try:
+        fresh = os.path.exists(so) and (
+            os.path.getmtime(so) >= os.path.getmtime(src)
+        )
+    except OSError:  # source missing: use the existing .so as-is
+        fresh = os.path.exists(so)
+    if not fresh and not _build_so(src, so, extra=extra):
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError as e:
+        log.warning("%s load failed: %s — using Python fallback", name, e)
+        return None
+    try:
+        return configure(lib)
+    except AttributeError as e:
+        # a stale cached .so missing newer exports (e.g. source file
+        # absent so no rebuild happened): degrade to the Python path
+        log.warning("%s stale/incomplete: %s — Python fallback", name, e)
+        return None
+
+
+def _configure_hostprep(lib):
+    lib.challenge_batch.argtypes = [
+        _u8p, _u8p, _u8p, _i64p, ctypes.c_int64, _u8p,
+    ]
+    lib.challenge_batch.restype = None
+    lib.sha512_batch.argtypes = [_u8p, _i64p, ctypes.c_int64, _u8p]
+    lib.sha512_batch.restype = None
+    lib.sc_reduce_batch.argtypes = [_u8p, ctypes.c_int64, _u8p]
+    lib.sc_reduce_batch.restype = None
+    lib.native_num_threads.argtypes = []
+    lib.native_num_threads.restype = ctypes.c_int
+    return lib
 
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     with _lock:
-        if _tried:
-            return _lib
-        _tried = True
-        fresh = os.path.exists(_SO) and (
-            os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
-        )
-        if not fresh and not _build():
-            return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError as e:
-            log.warning("native load failed: %s — using Python fallback", e)
-            return None
-        lib.challenge_batch.argtypes = [
-            _u8p, _u8p, _u8p, _i64p, ctypes.c_int64, _u8p,
-        ]
-        lib.challenge_batch.restype = None
-        lib.sha512_batch.argtypes = [_u8p, _i64p, ctypes.c_int64, _u8p]
-        lib.sha512_batch.restype = None
-        lib.sc_reduce_batch.argtypes = [_u8p, ctypes.c_int64, _u8p]
-        lib.sc_reduce_batch.restype = None
-        lib.native_num_threads.argtypes = []
-        lib.native_num_threads.restype = ctypes.c_int
-        _lib = lib
+        if not _tried:
+            _tried = True
+            _lib = _load_library(
+                _SRC, _SO, _configure_hostprep, extra=("-fopenmp",)
+            )
         return _lib
 
 
+def _configure_bls(lib):
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64 = ctypes.c_int64
+    lib.bls_verify_one.argtypes = [
+        u8p, u8p, i64, u8p, u8p, i64, ctypes.c_int,
+    ]
+    lib.bls_verify_one.restype = ctypes.c_int
+    lib.bls_verify_aggregate.argtypes = [
+        u8p, i64, u8p, i64, u8p, u8p, i64,
+    ]
+    lib.bls_verify_aggregate.restype = ctypes.c_int
+    lib.bls_sign.argtypes = [u8p, u8p, i64, u8p, i64, u8p]
+    lib.bls_sign.restype = ctypes.c_int
+    lib.bls_pubkey.argtypes = [u8p, u8p]
+    lib.bls_pubkey.restype = ctypes.c_int
+    lib.bls_selftest.argtypes = []
+    lib.bls_selftest.restype = ctypes.c_int
+    if lib.bls_selftest() != 1:
+        log.warning("bls381 selftest FAILED — using Python fallback")
+        return None
+    return lib
+
+
 def _load_bls() -> Optional[ctypes.CDLL]:
-    """Loader for the BLS12-381 pairing library (bls381.cpp) — same
-    build-on-demand + Python-fallback contract as the host-prep lib."""
     global _bls_lib, _bls_tried
     with _bls_lock:
-        if _bls_tried:
-            return _bls_lib
-        _bls_tried = True
-        try:
-            fresh = os.path.exists(_SO_BLS) and (
-                os.path.getmtime(_SO_BLS) >= os.path.getmtime(_SRC_BLS)
-            )
-        except OSError:  # source missing: use the existing .so as-is
-            fresh = os.path.exists(_SO_BLS)
-        if not fresh and not _build_so(_SRC_BLS, _SO_BLS):
-            return None
-        try:
-            lib = ctypes.CDLL(_SO_BLS)
-        except OSError as e:
-            log.warning("bls381 load failed: %s — using Python fallback", e)
-            return None
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        i64 = ctypes.c_int64
-        try:
-            lib.bls_verify_one.argtypes = [
-                u8p, u8p, i64, u8p, u8p, i64, ctypes.c_int,
-            ]
-            lib.bls_verify_one.restype = ctypes.c_int
-            lib.bls_verify_aggregate.argtypes = [
-                u8p, i64, u8p, i64, u8p, u8p, i64,
-            ]
-            lib.bls_verify_aggregate.restype = ctypes.c_int
-            lib.bls_sign.argtypes = [u8p, u8p, i64, u8p, i64, u8p]
-            lib.bls_sign.restype = ctypes.c_int
-            lib.bls_pubkey.argtypes = [u8p, u8p]
-            lib.bls_pubkey.restype = ctypes.c_int
-            lib.bls_selftest.argtypes = []
-            lib.bls_selftest.restype = ctypes.c_int
-        except AttributeError as e:
-            # a stale cached .so missing newer exports (e.g. source file
-            # absent so no rebuild happened): degrade to the Python path
-            log.warning("bls381 stale/incomplete: %s — Python fallback", e)
-            return None
-        if lib.bls_selftest() != 1:
-            log.warning("bls381 selftest FAILED — using Python fallback")
-            return None
-        _bls_lib = lib
+        if not _bls_tried:
+            _bls_tried = True
+            _bls_lib = _load_library(_SRC_BLS, _SO_BLS, _configure_bls)
         return _bls_lib
 
 
+def _configure_ed(lib):
+    _i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+    lib.ed25519_batch_verify.argtypes = [
+        _u8p, ctypes.c_int, _i32p, _u8p, _u8p, _u8p, _u8p, _u8p,
+        ctypes.c_int,
+    ]
+    lib.ed25519_batch_verify.restype = ctypes.c_int
+    return lib
+
+
 def _load_ed() -> Optional[ctypes.CDLL]:
-    """Loader for the batch Ed25519 verifier (ed25519.cpp) — same
-    build-on-demand + Python-fallback contract as the other libraries."""
     global _ed_lib, _ed_tried
     with _ed_lock:
-        if _ed_tried:
-            return _ed_lib
-        _ed_tried = True
-        try:
-            fresh = os.path.exists(_SO_ED) and (
-                os.path.getmtime(_SO_ED) >= os.path.getmtime(_SRC_ED)
-            )
-        except OSError:
-            fresh = os.path.exists(_SO_ED)
-        if not fresh and not _build_so(_SRC_ED, _SO_ED):
-            return None
-        try:
-            lib = ctypes.CDLL(_SO_ED)
-        except OSError as e:
-            log.warning("ed25519 load failed: %s — using fallback", e)
-            return None
-        try:
-            _i32p = np.ctypeslib.ndpointer(
-                dtype=np.int32, flags="C_CONTIGUOUS"
-            )
-            lib.ed25519_batch_verify.argtypes = [
-                _u8p, ctypes.c_int, _i32p, _u8p, _u8p, _u8p, _u8p, _u8p,
-                ctypes.c_int,
-            ]
-            lib.ed25519_batch_verify.restype = ctypes.c_int
-        except AttributeError as e:
-            log.warning("ed25519 stale/incomplete: %s — fallback", e)
-            return None
-        _ed_lib = lib
+        if not _ed_tried:
+            _ed_tried = True
+            _ed_lib = _load_library(_SRC_ED, _SO_ED, _configure_ed)
         return _ed_lib
 
 
