@@ -35,23 +35,6 @@ def run(coro, timeout=60):
 # ---------------------------------------------------------------------------
 
 
-async def _committee_with_checkpoint():
-    """n=4, checkpoint_interval=2: two commits produce a stable
-    checkpoint at seq 2 with an honest digest."""
-    com = LocalCommittee.build(n=4, clients=1, checkpoint_interval=2)
-    com.start()
-    for i in range(2):
-        assert await com.clients[0].submit(f"put c{i} {i}") == "ok"
-    # wait for every replica to emit + stabilize the seq-2 checkpoint
-    t0 = asyncio.get_running_loop().time()
-    while (
-        any(r.stable_seq < 2 for r in com.replicas)
-        and asyncio.get_running_loop().time() - t0 < 20
-    ):
-        await asyncio.sleep(0.05)
-    return com
-
-
 def test_lying_checkpoint_digest_cannot_stabilize():
     async def scenario():
         com = LocalCommittee.build(n=4, clients=1, checkpoint_interval=2)
