@@ -162,6 +162,8 @@ def _configure_ed(lib):
         ctypes.c_int,
     ]
     lib.ed25519_batch_verify.restype = ctypes.c_int
+    lib.ed25519_fused_table.argtypes = [_u8p, ctypes.c_int, _u8p]
+    lib.ed25519_fused_table.restype = ctypes.c_int
     return lib
 
 
@@ -176,6 +178,24 @@ def _load_ed() -> Optional[ctypes.CDLL]:
 
 def ed25519_available() -> bool:
     return _load_ed() is not None
+
+
+def ed25519_fused_table(
+    a_xy: np.ndarray, wbits: int
+) -> Optional[np.ndarray]:
+    """Affine pubkey (64,) uint8 (x||y LE) -> (npos * 4^wbits, 96) uint8
+    affine-Niels field-element bytes for the fused dual-scalar comb
+    (KeyBank cold-start fast path); None = library unavailable."""
+    lib = _load_ed()
+    if lib is None:
+        return None
+    npos = -(-256 // wbits)
+    n = npos * (1 << wbits) ** 2
+    out = np.empty((n, 96), dtype=np.uint8)
+    rc = lib.ed25519_fused_table(
+        np.ascontiguousarray(a_xy, dtype=np.uint8), wbits, out
+    )
+    return out if rc == 0 else None
 
 
 def ed25519_batch_verify(

@@ -316,21 +316,28 @@ static ge_proj ge_to_proj(const ge &p) {
 static ge_aff BASE_TABLE[64][16];
 static std::once_flag base_once;
 
-static void build_base_table() {
-    // B's standard affine coordinates
-    static const uint8_t BX[32] = {
-        0x1a, 0xd5, 0x25, 0x8f, 0x60, 0x2d, 0x56, 0xc9, 0xb2, 0xa7, 0x25,
-        0x95, 0x60, 0xc7, 0x2c, 0x69, 0x5c, 0xdc, 0xd6, 0xfd, 0x31, 0xe2,
-        0xa4, 0xc0, 0xfe, 0x53, 0x6e, 0xcd, 0xd3, 0x36, 0x69, 0x21};
-    static const uint8_t BY[32] = {
-        0x58, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66,
-        0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66,
-        0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66};
+// B's standard affine coordinates (single definition — both the comb
+// table and the fused-table builder start from these)
+static const uint8_t BX[32] = {
+    0x1a, 0xd5, 0x25, 0x8f, 0x60, 0x2d, 0x56, 0xc9, 0xb2, 0xa7, 0x25,
+    0x95, 0x60, 0xc7, 0x2c, 0x69, 0x5c, 0xdc, 0xd6, 0xfd, 0x31, 0xe2,
+    0xa4, 0xc0, 0xfe, 0x53, 0x6e, 0xcd, 0xd3, 0x36, 0x69, 0x21};
+static const uint8_t BY[32] = {
+    0x58, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66,
+    0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66,
+    0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66};
+
+static ge ge_basepoint() {
     ge base;
     base.X = fe_frombytes(BX);
     base.Y = fe_frombytes(BY);
     base.Z = fe_one();
     base.T = fe_mul(base.X, base.Y);
+    return base;
+}
+
+static void build_base_table() {
+    ge base = ge_basepoint();
 
     // entries in extended coords first, batch-normalize at the end
     static ge ext[64][16];
@@ -403,6 +410,88 @@ static int scalar_wnaf(const uint8_t s[32], int8_t naf[257]) {
 // ---------------------------------------------------------------------------
 // Entry point
 // ---------------------------------------------------------------------------
+
+// ---------------------------------------------------------------------------
+// Fused dual-scalar comb table construction (KeyBank cold-start path).
+//
+// Mirrors ops/comb.py fused_table_np: row[i*4^w + ws*2^w + wk] =
+// (ws * 2^(w*i)) B + (wk * 2^(w*i)) (-A), emitted as affine Niels
+// (y+x, y-x, 2dxy) 32-byte LE field elements (96 B/entry) — Python
+// converts to the TPU limb packing with its existing vectorized path.
+// The Python bigint build costs ~0.2 s/key at w=4 (~2 s at w=6); this
+// native build is ~milliseconds, making a cold n=64 committee bank a
+// sub-second affair instead of tens of seconds.
+// ---------------------------------------------------------------------------
+
+static ge ge_neg(const ge &p) {
+    ge r = p;
+    r.X = fe_carry(fe_sub(fe_zero(), p.X));
+    r.T = fe_carry(fe_sub(fe_zero(), p.T));
+    return r;
+}
+
+extern "C" int ed25519_fused_table(
+    const uint8_t a_xy[64],  // pubkey affine x||y (32B LE each)
+    int wbits,               // window bits (4..6)
+    uint8_t *out)            // npos * 4^wbits * 96 bytes
+{
+    if (wbits < 1 || wbits > 8) return -1;
+    const int window = 1 << wbits;
+    const int fw = window * window;
+    const int npos = (256 + wbits - 1) / wbits;
+    const int n = npos * fw;
+
+    ge base_b = ge_basepoint();
+    ge A;
+    A.X = fe_frombytes(a_xy);
+    A.Y = fe_frombytes(a_xy + 32);
+    A.Z = fe_one();
+    A.T = fe_mul(A.X, A.Y);
+    ge base_a = ge_neg(A);
+
+    ge *ext = new ge[n];
+    int idx = 0;
+    for (int pos = 0; pos < npos; pos++) {
+        ge_proj bp = ge_to_proj(base_b);
+        ge_proj ap = ge_to_proj(base_a);
+        ge row_b = ge_identity();
+        for (int ws = 0; ws < window; ws++) {
+            ge acc = row_b;
+            for (int wk = 0; wk < window; wk++) {
+                ext[idx++] = acc;
+                acc = ge_padd(acc, ap);
+            }
+            row_b = ge_padd(row_b, bp);
+        }
+        for (int d = 0; d < wbits; d++) {
+            base_b = ge_dbl(base_b);
+            base_a = ge_dbl(base_a);
+        }
+    }
+
+    // batch-invert all Z's, emit affine Niels bytes (ext stays live
+    // through the backward pass, so Z is read in place)
+    fe *prefix = new fe[n + 1];
+    prefix[0] = fe_one();
+    for (int i = 0; i < n; i++) {
+        prefix[i + 1] = fe_mul(prefix[i], ext[i].Z);
+    }
+    fe inv = fe_invert(prefix[n]);
+    fe d2 = fe_d2();
+    for (int i = n - 1; i >= 0; i--) {
+        fe zinv = fe_mul(prefix[i], inv);
+        inv = fe_mul(inv, ext[i].Z);
+        fe x = fe_mul(ext[i].X, zinv);
+        fe y = fe_mul(ext[i].Y, zinv);
+        uint8_t *o = out + (size_t)i * 96;
+        fe_tobytes(o, fe_add(y, x));
+        fe_tobytes(o + 32, fe_sub(y, x));
+        fe_tobytes(o + 64, fe_mul(fe_mul(x, y), d2));
+    }
+    delete[] prefix;
+    delete[] ext;
+    return 0;
+}
 
 extern "C" int ed25519_batch_verify(
     const uint8_t *a_xy,       // n_keys * 64: affine x||y (32B LE each)

@@ -132,6 +132,26 @@ def fused_table_np(point: ref.Point, wbits: int = 4) -> np.ndarray:
     ~13.6 MB, w=6 -> 43 / ~45 MB. Keys are few (a committee) and
     endlessly reused, so the build amortizes; KeyBank caps total memory.
     """
+    # Native fast path (native/ed25519.cpp): the same build in C++ group
+    # arithmetic, ~80x the Python bigint loop — the difference between a
+    # sub-second and a half-minute cold KeyBank at n=64 (and w=6 tables
+    # are 10x bigger still). Output is affine-Niels field-element BYTES;
+    # the vectorized bytes->limb conversion below is shared with the
+    # Python path, so both produce bit-identical packed rows.
+    from .. import native
+
+    x, y = ref.point_to_affine(point)
+    a_xy = np.frombuffer(
+        x.to_bytes(32, "little") + y.to_bytes(32, "little"), dtype=np.uint8
+    )
+    nb = native.ed25519_fused_table(a_xy, wbits)
+    if nb is not None:
+        n = nb.shape[0]
+        limbs = fe.bytes32_to_limbs_np(
+            nb.reshape(n * 3, 32)
+        ).reshape(n, 3, fe.NLIMB)
+        return _pack_rows_np(limbs)
+
     window = 1 << wbits
     pts = []
     base_b = ref.B

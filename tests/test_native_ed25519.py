@@ -99,6 +99,27 @@ def test_empty_and_single():
     assert _native.verify_batch([it]) == [True]
 
 
+@pytest.mark.parametrize("wbits", [4, 5, 6])
+def test_native_fused_table_bit_exact(wbits):
+    """The C++ fused-table build must produce byte-identical packed rows
+    to the exact-bigint Python path for every window width — the KeyBank
+    swaps between them transparently."""
+    import numpy as np
+
+    from simple_pbft_tpu import native
+    from simple_pbft_tpu.ops import comb
+
+    pt = ref.point_decompress(ref.public_key(bytes([40 + wbits]) * 32))
+    nat = comb.fused_table_np(pt, wbits)
+    orig = native.ed25519_fused_table
+    native.ed25519_fused_table = lambda *a: None  # force the Python path
+    try:
+        py = comb.fused_table_np(pt, wbits)
+    finally:
+        native.ed25519_fused_table = orig
+    assert np.array_equal(nat, py)
+
+
 def test_key_cache_remap_across_calls():
     """Key bank grows across calls; later batches referencing a subset of
     cached keys must remap indices correctly."""
