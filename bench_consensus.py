@@ -35,11 +35,19 @@ import sys
 import time
 from typing import List
 
+# plain hosts honor the env var; chip-tunnel hosts override it via
+# sitecustomize (axon), which is exactly right for --verifier tpu runs
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     ).strip()
+if os.environ.get("BENCH_FORCE_CPU") == "1":
+    # shake out --verifier tpu plumbing without the chip: must run
+    # BEFORE any simple_pbft_tpu import could touch a jax backend
+    from simple_pbft_tpu import force_cpu
+
+    force_cpu()
 
 
 def _emit(rec: dict) -> None:

@@ -27,6 +27,18 @@ redesigned TPU-first:
 __version__ = "0.1.0"
 
 
+def force_cpu() -> None:
+    """Force the JAX CPU backend IN-PROCESS, before any backend
+    initializes. On chip-tunnel hosts the ambient sitecustomize
+    force-registers the axon TPU backend and OVERRIDES the JAX_PLATFORMS
+    env var, so code that must not touch the (possibly wedged) tunnel —
+    CPU test suites, bench smoke runs, plumbing shakeouts — calls this
+    first instead of trusting the environment."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def enable_jit_cache(path: str | None = None) -> None:
     """Point JAX's persistent compilation cache at a shared directory so
     the crypto kernels (40-60 s compiles on small CPU hosts) compile once

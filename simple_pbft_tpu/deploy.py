@@ -7,10 +7,16 @@ a deployment is a JSON document shared by every node and client:
     {
       "options": {"checkpoint_interval": 64, "view_timeout": 2.0, ...},
       "replicas": {"r0": {"host": "127.0.0.1", "port": 7000,
-                           "pubkey": "<hex>"}, ...},
+                           "pubkey": "<hex>",
+                           "kx_pubkey": "<hex>"}, ...},
       "clients":  {"c0": {"host": "127.0.0.1", "port": 7500,
-                           "pubkey": "<hex>"}, ...}
+                           "pubkey": "<hex>",
+                           "kx_pubkey": "<hex>"}, ...}
     }
+
+``kx_pubkey`` (X25519, optional) enables MAC-authenticated replies
+between that node and its peers (crypto/mac.py); entries lacking it
+fall back to Ed25519-signed replies.
 
 Private key seeds live in separate per-node files (`<id>.seed`, 32 raw
 bytes) so the shared document carries no secrets.
@@ -62,19 +68,33 @@ def generate(
     names = [(f"r{i}", "replicas", base_port + i) for i in range(n)] + [
         (f"c{i}", "clients", base_port + 500 + i) for i in range(clients)
     ]
+    from .crypto import mac as mac_mod
+
+    kx_pubkeys: Dict[str, bytes] = {}
     for name, kind, port in names:
         seed = os.urandom(32)
         kp = KeyPair.generate(seed)
+        kx = mac_mod.kx_pubkey(seed)
         with open(os.path.join(out_dir, f"{name}.seed"), "wb") as fh:
             fh.write(seed)
-        doc[kind][name] = {"host": host, "port": port, "pubkey": kp.pub.hex()}
+        doc[kind][name] = {
+            "host": host,
+            "port": port,
+            "pubkey": kp.pub.hex(),
+            # X25519 key-exchange pubkey: enables MAC'd replies (the
+            # point-to-point fast path, crypto/mac.py); derived from the
+            # same seed so the per-node secret material stays one file
+            "kx_pubkey": kx.hex(),
+        }
         addresses[name] = (host, port)
         pubkeys[name] = kp.pub
+        kx_pubkeys[name] = kx
     with open(os.path.join(out_dir, "committee.json"), "w") as fh:
         json.dump(doc, fh, indent=2, sort_keys=True)
     cfg = CommitteeConfig(
         replica_ids=tuple(sorted(doc["replicas"])),
         pubkeys=pubkeys,
+        kx_pubkeys=kx_pubkeys,
         **{k: v for k, v in options.items() if k in _OPTION_FIELDS},
     )
     return Deployment(cfg=cfg, addresses=addresses)
@@ -93,6 +113,7 @@ def load(path: str) -> Deployment:
         raise ValueError("deployment needs a non-empty 'replicas' map")
     addresses: Dict[str, Tuple[str, int]] = {}
     pubkeys: Dict[str, bytes] = {}
+    kx_pubkeys: Dict[str, bytes] = {}
     for kind in (replicas, clients):
         for name, ent in kind.items():
             if not isinstance(ent, dict):
@@ -100,11 +121,16 @@ def load(path: str) -> Deployment:
             try:
                 addresses[name] = (str(ent["host"]), int(ent["port"]))
                 pubkeys[name] = bytes.fromhex(ent["pubkey"])
+                # optional (older documents lack it): its absence just
+                # falls the affected pairs back to Ed25519-signed replies
+                if "kx_pubkey" in ent:
+                    kx_pubkeys[name] = bytes.fromhex(ent["kx_pubkey"])
             except (KeyError, TypeError, ValueError) as e:
                 raise ValueError(f"bad node entry {name}: {e}") from None
     cfg = CommitteeConfig(
         replica_ids=tuple(sorted(replicas)),
         pubkeys=pubkeys,
+        kx_pubkeys=kx_pubkeys,
         **{k: v for k, v in options.items() if k in _OPTION_FIELDS},
     )
     return Deployment(cfg=cfg, addresses=addresses)
