@@ -172,16 +172,19 @@ async def run_config(
         qc_mode=qc_mode,
     )
     for c in com.clients:
-        # Storms: the first send of a request goes to a (possibly just
-        # crashed) primary and NOTHING reaches the committee until this
-        # timer triggers the broadcast retry — so it must be a small
-        # multiple of failover time, not a lazy 30 s (which was the
-        # entire tail of every storm p99). Steady-state benches keep the
-        # long timeout so retries never distort throughput numbers.
-        c.request_timeout = 1.5 * (view_timeout or 3.0) if storm else 30.0
-        if storm:
-            # hedged first sends: a crashing primary must not be the only
-            # holder of the in-flight batch (see client.Client.hedge)
+        # Storms/chaos: the first send of a request can go to a crashed
+        # primary (storm) or get dropped outright (chaos) and NOTHING
+        # reaches the committee until this timer triggers the broadcast
+        # retry — so it must be a small multiple of failover time, not a
+        # lazy 30 s (which was the entire tail of every storm p99).
+        # Clean steady-state benches keep the long timeout so retries
+        # never distort throughput numbers.
+        degraded = storm or bool(chaos)
+        c.request_timeout = 1.5 * (view_timeout or 3.0) if degraded else 30.0
+        if degraded:
+            # hedged first sends: a crashed primary or a dropped frame
+            # must not leave the request unknown to the whole committee
+            # (see client.Client.hedge)
             c.hedge = 2
     com.start()
 

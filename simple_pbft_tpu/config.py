@@ -60,6 +60,17 @@ class CommitteeConfig:
         """f+1 — at least one honest replica (client reply matching)."""
         return self.f + 1
 
+    @property
+    def repliers(self) -> int:
+        """Designated-replier set size: f+1 matching replies is what the
+        client NEEDS, but transmitting exactly f+1 leaves zero slack — a
+        single dropped reply (or one slow designee) then costs a full
+        client timeout (measured: 2% message loss at n=64 pushed reply
+        p50 to the whole 30 s retry period). A few spares make the
+        common case loss-tolerant while still saving the n-f-1 wasted
+        signs/sends the rotation exists to avoid."""
+        return min(self.n, self.weak_quorum + max(1, self.f // 4))
+
     def primary(self, view: int) -> str:
         """Round-robin primary rotation (the reference sketched this in its
         dead view.go:13-31 but never wired it)."""

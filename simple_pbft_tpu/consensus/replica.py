@@ -54,6 +54,7 @@ from ..messages import (
     QuorumCert,
     Reply,
     Request,
+    SlotFetch,
     StateRequest,
     StateResponse,
     ViewChange,
@@ -174,6 +175,8 @@ class Replica:
         from ..crypto import mac as mac_mod
 
         self._mac = mac_mod.MacBank(seed, cfg.kx_pubkeys)
+        # SlotFetch rate limiting: sender -> monotonic time last served
+        self._slot_fetch_served: Dict[str, float] = {}
 
     def _auth_reply(self, reply: Reply) -> None:
         """Authenticate a reply: per-client HMAC when BOTH ends publish kx
@@ -456,7 +459,8 @@ class Replica:
         if isinstance(
             msg,
             (PrePrepare, Prepare, Commit, Checkpoint, ViewChange, NewView,
-             QuorumCert, StateRequest, StateResponse, BlockFetch, BlockReply),
+             QuorumCert, StateRequest, StateResponse, BlockFetch, BlockReply,
+             SlotFetch),
         ):
             if msg.sender not in self._replica_set:
                 return []
@@ -580,6 +584,8 @@ class Replica:
             await self._on_block_fetch(msg)
         elif isinstance(msg, BlockReply):
             await self._on_block_reply(msg)
+        elif isinstance(msg, SlotFetch):
+            await self._on_slot_fetch(msg)
         elif isinstance(msg, (ViewChange, NewView)):
             await self._on_view_message(msg)
         else:
@@ -934,16 +940,17 @@ class Replica:
                 self.recent_replies.setdefault(req.client_id, {})[
                     req.timestamp
                 ] = reply
-                # Designated repliers: exactly f+1 replicas (rotating by
-                # seq) sign and transmit — f+1 matching is all the client
-                # can use, so the other n-f-1 signatures and sends were
-                # pure waste (at n=100: 66 signs + 66 client-side decodes
-                # per request). Everyone still CACHES the reply: if a
-                # designated replier is faulty or slow, the client's
-                # retransmission hits the _on_request duplicate branch,
-                # where every replica signs-on-demand and resends the
-                # cached reply (the liveness fallback).
-                if (self._index - act.seq) % self.cfg.n < self.cfg.weak_quorum:
+                # Designated repliers: cfg.repliers replicas (f+1 plus a
+                # few loss-tolerance spares, rotating by seq) sign and
+                # transmit — f+1 matching is all the client can use, so
+                # the remaining signatures and sends were pure waste (at
+                # n=100: ~58 signs + client-side decodes per request).
+                # Everyone still CACHES the reply: if the designated set
+                # is unlucky (drops, faults), the client's retransmission
+                # hits the _on_request duplicate branch, where every
+                # replica signs-on-demand and resends the cached reply
+                # (the liveness fallback).
+                if (self._index - act.seq) % self.cfg.n < self.cfg.repliers:
                     self._auth_reply(reply)
                     await self.transport.send(req.client_id, reply.to_wire())
             if self.executed_seq % self.cfg.checkpoint_interval == 0:
@@ -1270,6 +1277,73 @@ class Replica:
                     self.vc_replay[filled.seq] = filled
                 else:
                     await self._on_phase(filled)
+
+    # ------------------------------------------------------------------
+    # steady-state hole filling (messages.SlotFetch)
+    # ------------------------------------------------------------------
+
+    MAX_SLOT_FETCH = 64  # slots served per request
+    SLOT_FETCH_COOLDOWN = 1.0  # per-sender seconds (DoS bound)
+
+    def missing_slots(self) -> List[int]:
+        """Unexecuted seqs a peer could unstick: everything from the
+        execution frontier up to the highest slot we know is in flight
+        (bounded). The FIRST entry is the hole that blocks execution."""
+        horizon = self.executed_seq
+        for (v, s) in self.instances:
+            if v == self.view and s > horizon:
+                horizon = max(horizon, s)
+        horizon = min(horizon, self.executed_seq + self.MAX_SLOT_FETCH)
+        return [
+            s
+            for s in range(self.executed_seq + 1, horizon + 1)
+            if s not in self.ready
+        ]
+
+    async def send_slot_probe(self) -> None:
+        """Ask the current primary to re-send stalled slots' artifacts.
+        Fired by the failover machinery at HALF the view timeout: a
+        dropped QC/pre-prepare then heals with one round trip instead of
+        a full view change."""
+        seqs = self.missing_slots()
+        if not seqs or self.vc.in_view_change:
+            return
+        fetch = SlotFetch(view=self.view, seqs=seqs)
+        self.signer.sign_msg(fetch)
+        self.metrics["slot_probes_sent"] += 1
+        await self.transport.send(
+            self.cfg.primary(self.view), fetch.to_wire()
+        )
+
+    async def _on_slot_fetch(self, msg: SlotFetch) -> None:
+        if msg.view != self.view or not isinstance(msg.seqs, list):
+            return
+        now = time.monotonic()
+        last = self._slot_fetch_served.get(msg.sender, 0.0)
+        if now - last < self.SLOT_FETCH_COOLDOWN:
+            self.metrics["slot_fetch_throttled"] += 1
+            return
+        self._slot_fetch_served[msg.sender] = now
+        served = 0
+        for seq in msg.seqs[: self.MAX_SLOT_FETCH]:
+            if not isinstance(seq, int):
+                return
+            inst = self.instances.get((msg.view, seq))
+            if inst is None:
+                continue
+            if inst.pre_prepare is not None and inst.pre_prepare.block:
+                await self.transport.send(
+                    msg.sender, inst.pre_prepare.to_wire()
+                )
+                served += 1
+            # QC mode: the aggregates are the quorum; re-send our stored
+            # copies (self-certifying — any replica may relay them)
+            for qc in (inst.prepare_qc, inst.commit_qc):
+                if qc is not None:
+                    await self.transport.send(msg.sender, qc.to_wire())
+                    served += 1
+        if served:
+            self.metrics["slot_fetches_served"] += 1
 
     async def _on_state_request(self, msg: StateRequest) -> None:
         snap = self.snapshots.get(msg.seq)

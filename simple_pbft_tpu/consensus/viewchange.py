@@ -338,6 +338,8 @@ class ViewChanger:
         self.vc_store: Dict[int, Dict[str, Optional[ViewChange]]] = {}
         self.new_view_sent: set = set()
         self._timer: Optional[asyncio.TimerHandle] = None
+        self._probe_timer: Optional[asyncio.TimerHandle] = None
+        self._probe_task: Optional[asyncio.Task] = None
         self._vc_task: Optional[asyncio.Task] = None
         self._timeout = replica.cfg.view_timeout
         self._nv_granted: set = set()  # views granted a NEW-VIEW window
@@ -346,10 +348,17 @@ class ViewChanger:
 
     def arm(self) -> None:
         """Arm the failover timer if not already armed (called whenever a
-        request is outstanding)."""
+        request is outstanding). A recovery PROBE fires at half the
+        timeout: a stalled slot (dropped QC or pre-prepare — execution
+        is sequential, so one hole blocks a replica forever) then heals
+        with one SlotFetch round trip instead of a view change."""
         if self._timer is None and self.r.cfg.view_timeout > 0:
             loop = asyncio.get_running_loop()
             self._timer = loop.call_later(self._timeout, self._expired)
+            if self._probe_timer is None:
+                self._probe_timer = loop.call_later(
+                    self._timeout / 2, self._probe
+                )
 
     def reset(self) -> None:
         """Progress was made: reset the backoff, re-arm if work remains."""
@@ -369,6 +378,24 @@ class ViewChanger:
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
+        if self._probe_timer is not None:
+            self._probe_timer.cancel()
+            self._probe_timer = None
+
+    def _probe(self) -> None:
+        self._probe_timer = None
+        if not self.r.has_outstanding_work() or self.in_view_change:
+            return
+        # retain the task (a bare ensure_future can be collected mid-send)
+        self._probe_task = asyncio.ensure_future(self.r.send_slot_probe())
+        self._probe_task.add_done_callback(
+            lambda _t: setattr(self, "_probe_task", None)
+        )
+        # keep probing while the stall lasts (the response itself can be
+        # dropped); the server side rate-limits per sender
+        self._probe_timer = asyncio.get_running_loop().call_later(
+            max(0.5, self._timeout / 2), self._probe
+        )
 
     def _expired(self) -> None:
         self._timer = None
