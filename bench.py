@@ -17,10 +17,25 @@ prep is timed and reported separately in the JSON for honesty; the headline
 is device throughput (host prep overlaps with device compute in the
 pipelined runtime — see crypto/tpu_verifier.py).
 
+Attach strategy (round 4): the tunnel to the chip flaps for hours at a
+time, and a single blocking `jax.devices()` can hang forever — rounds 1-3
+each burned their whole driver budget inside one attach attempt. So the
+default entrypoint is now a small ORCHESTRATOR that never imports jax:
+it probes the tunnel in a subprocess with a short timeout, retries in a
+loop across the whole budget, and only once a probe confirms a live
+non-CPU device does it spawn the real measurement as a `--_worker`
+subprocess (which keeps its own watchdog). Any nonzero measurement the
+worker produces — even one cut short by a later hang — is forwarded, so
+a healthy window of any length turns into a recorded number.
+
 Env knobs: BENCH_BATCH (top batch size; capped at 8192 unless
 BENCH_ALLOW_BIG=1 — a killed 16384+ compile wedged the device tunnel for
 hours once, so big compiles never run inside the default driver budget),
 BENCH_SIGNERS, BENCH_TIMEOUT (wall-clock budget in seconds, default 420),
+BENCH_PROBE_TIMEOUT (per-attach-probe subprocess timeout, default 45),
+BENCH_PROBE_RETRY_SLEEP (pause between failed probes, default 20),
+BENCH_DIRECT=1 (skip the orchestrator: attach + measure in-process,
+for hosts with a known-good local device),
 BENCH_MODE (fused|comb — fused is one gather + one mixed add per nibble
 position, half the comb engine's madds), BENCH_WINDOW (fused window bits,
 4|5|6), BENCH_MUL (skew|padacc field-multiply formulation), BENCH_ACCUM
@@ -45,6 +60,13 @@ import time
 import numpy as np
 
 _best = {"value": 0.0, "batch": 0, "note": "no measurement completed"}
+# facts that must survive into a watchdog-truncated record (platform, mode,
+# ...) — set as soon as known, merged into every emitted line
+_sticky: dict = {}
+# orchestrator only: the best worker record captured so far; the single
+# emit path below prefers it over a zero/error line, so a measurement in
+# hand always beats a timeout report no matter which thread emits
+_best_rec: dict | None = None
 _emit_lock = threading.Lock()
 _emitted = False
 
@@ -55,16 +77,22 @@ def _emit(error: str | None = None, **extra) -> None:
         if _emitted:
             return
         _emitted = True
-        rec = {
-            "metric": "ed25519_verifies_per_sec_per_chip",
-            "value": round(_best["value"], 1),
-            "unit": "verifies/s",
-            "vs_baseline": round(_best["value"] / 1_000_000, 4),
-            "batch": _best["batch"],
-            "note": _best["note"],
-        }
-        if error is not None:
-            rec["error"] = error[:500]
+        if _best_rec is not None and _best_rec.get("value", 0) >= _best["value"]:
+            rec = dict(_best_rec)
+            if error is not None:
+                rec["orchestrator_error"] = error[:300]
+        else:
+            rec = {
+                "metric": "ed25519_verifies_per_sec_per_chip",
+                "value": round(_best["value"], 1),
+                "unit": "verifies/s",
+                "vs_baseline": round(_best["value"] / 1_000_000, 4),
+                "batch": _best["batch"],
+                "note": _best["note"],
+            }
+            rec.update(_sticky)
+            if error is not None:
+                rec["error"] = error[:500]
         rec.update(extra)
         # os.write on the raw fd: must succeed even if the main thread is
         # wedged inside a jaxlib C call holding buffered-stdout state.
@@ -102,7 +130,7 @@ def _measure(fn, arrays, batch: int, min_s: float, max_iters: int) -> float:
     return batch * iters / elapsed
 
 
-def main() -> None:
+def _worker_main() -> None:
     budget = float(os.environ.get("BENCH_TIMEOUT", "420"))
     _start_watchdog(budget)
     t_start = time.perf_counter()
@@ -153,8 +181,10 @@ def main() -> None:
     assert mode in ("fused", "comb"), mode
     # comb mode is fixed at 4-bit windows; report what actually runs
     wbits = int(os.environ.get("BENCH_WINDOW", "4")) if mode == "fused" else 4
+    _sticky.update(mode=mode, window=wbits, mul=mul_impl)
     _best["note"] = "querying devices (tunnel attach)"
     platform = jax.devices()[0].platform
+    _sticky["platform"] = platform
     _best["note"] = f"devices up ({platform}); preparing batch"
     top_batch = int(os.environ.get("BENCH_BATCH", str(BUCKETS[-1])))
     # comb kernel's batch inversion needs a power-of-two batch
@@ -322,9 +352,146 @@ def main() -> None:
     )
 
 
+# --- orchestrator (no jax imports in this section) -----------------------
+
+_PROBE_SRC = r"""
+import json, time
+t0 = time.time()
+import jax
+d = jax.devices()[0]
+jax.device_put(1.0, d)
+print(json.dumps({"platform": d.platform, "attach_s": round(time.time() - t0, 1)}))
+"""
+
+
+def _last_json_line(text: str) -> dict | None:
+    for line in reversed((text or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def _probe(timeout_s: float) -> dict:
+    """Attach to the device in a THROWAWAY subprocess. A hung attach
+    (the historical failure mode: tunnel up enough to register the
+    backend, dead enough that jax.devices() never returns) costs
+    `timeout_s`, not the whole budget. The subprocess is killed while
+    still attaching — before any compile — which experience says the
+    tunnel tolerates (unlike mid-compile kills)."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "why": f"attach hung >{timeout_s:.0f}s"}
+    info = _last_json_line(r.stdout)
+    if info is not None:
+        if info.get("platform") == "cpu":
+            # attach "succeeded" but no chip is visible (axon backend
+            # absent/declined) — for the chip metric that is a failure;
+            # CPU-host users run --smoke or BENCH_DIRECT=1 instead
+            return {"ok": False, "why": "attach ok but only cpu visible", **info}
+        return {"ok": True, **info}
+    tail = (r.stderr or "").strip().splitlines()
+    return {
+        "ok": False,
+        "why": f"probe rc={r.returncode}: {tail[-1][:200] if tail else 'no output'}",
+    }
+
+
+def _run_worker(wbudget: float) -> dict | None:
+    """Run the measurement in a subprocess with its own watchdog; return
+    its JSON record (which the worker emits even on timeout)."""
+    import subprocess
+
+    env = dict(os.environ, BENCH_TIMEOUT=f"{wbudget:.0f}")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--_worker"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=sys.stderr,
+            text=True,
+            timeout=wbudget + 30,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"worker hard-hung past its {wbudget:.0f}s watchdog"}
+    return _last_json_line(r.stdout) or {
+        "error": f"worker rc={r.returncode} emitted no JSON"
+    }
+
+
+def main() -> None:
+    global _best_rec
+    budget = float(os.environ.get("BENCH_TIMEOUT", "420"))
+    _start_watchdog(budget)
+    deadline = time.time() + budget
+    probe_t = float(os.environ.get("BENCH_PROBE_TIMEOUT", "45"))
+    retry_sleep = float(os.environ.get("BENCH_PROBE_RETRY_SLEEP", "20"))
+    probes: list[dict] = []
+    last_worker_err = None
+    while True:
+        remaining = deadline - time.time()
+        if remaining < 75:
+            break
+        _best["note"] = f"probing tunnel (attempt {len(probes) + 1})"
+        res = _probe(min(probe_t, remaining - 30))
+        probes.append(res)
+        print(f"probe {len(probes)}: {res}", file=sys.stderr)
+        if res.get("ok"):
+            # leave margin so the worker's own watchdog emission, the
+            # subprocess timeout (+30) and our forwarding all land before
+            # the orchestrator watchdog fires at `budget`
+            wbudget = deadline - time.time() - 45
+            if wbudget < 50:
+                break
+            _best["note"] = f"worker measuring (probe ok, attach {res.get('attach_s')}s)"
+            rec = _run_worker(wbudget)
+            if rec and rec.get("value", 0) > 0:
+                rec["probe_attempts"] = len(probes)
+                rec["attach_s"] = res.get("attach_s")
+                # a real measurement (possibly truncated): hold it where
+                # every emit path — clean exit, watchdog, exception —
+                # prefers it over a zero/error line
+                if _best_rec is None or rec["value"] > _best_rec.get("value", 0):
+                    _best_rec = rec
+                if "error" not in rec:
+                    _emit()
+                    return
+            last_worker_err = (rec or {}).get("error", "worker emitted nothing")
+            print(f"worker attempt failed: {last_worker_err}", file=sys.stderr)
+        else:
+            time.sleep(max(0.0, min(retry_sleep, deadline - time.time() - 75)))
+    if _best_rec is not None:
+        _emit()
+        return
+    last = probes[-1] if probes else {"why": "no probe ran"}
+    err = (
+        f"no chip measurement in {budget:.0f}s after {len(probes)} probe "
+        f"attempts; last probe: {last.get('why', last)}"
+    )
+    if last_worker_err:
+        err += f"; last worker error: {last_worker_err}"
+    _emit(error=err, probe_attempts=len(probes))
+
+
 if __name__ == "__main__":
     try:
-        main()
+        if "--_worker" in sys.argv or "--smoke" in sys.argv or (
+            os.environ.get("BENCH_DIRECT") == "1"
+        ):
+            _worker_main()
+        else:
+            main()
     except BaseException as e:  # noqa: BLE001 — always emit the JSON line
         if not isinstance(e, SystemExit):
             _emit(error=f"{type(e).__name__}: {e}")
