@@ -78,6 +78,11 @@ class Client:
         self._waiters: Dict[int, asyncio.Future] = {}
         # per-ts replies: sender -> (result, superseded) — matched as a pair
         self._replies: Dict[int, Dict[str, tuple]] = defaultdict(dict)
+        # wire bytes of in-flight requests, for the mixed-split early
+        # rebroadcast below (submit() owns the normal retransmission)
+        self._inflight_raw: Dict[int, bytes] = {}
+        self._mixed_retry_done: set = set()
+        self._bg_tasks: set = set()
         self._task: Optional[asyncio.Task] = None
         self.view_hint = 0  # latest view seen in replies
 
@@ -163,6 +168,41 @@ class Client:
                 else:
                     fut.set_result(result)
                 return
+        # Mixed superseded/real split with no quorum: a checkpoint fold
+        # raced our retransmission — replicas that folded answer
+        # superseded=1 while laggards re-send the cached real reply, and
+        # with designated repliers neither pair may reach f+1 until the
+        # fold stabilizes committee-wide (replica._send_superseded has
+        # the server-side account). Stabilization needs no help from us,
+        # but the answer does: nudge with one early rebroadcast (folded
+        # replicas re-answer superseded from durable state) instead of
+        # sitting out the full request_timeout.
+        flags = {s for _, s in self._replies[ts].values()}
+        if len(flags) == 2 and ts not in self._mixed_retry_done:
+            self._mixed_retry_done.add(ts)
+            raw = self._inflight_raw.get(ts)
+            if raw is not None:
+                loop = asyncio.get_running_loop()
+                backoff = min(0.25, self.request_timeout / 4)
+                loop.call_later(backoff, self._fire_mixed_retry, ts, raw)
+
+    def _fire_mixed_retry(self, ts: int, raw: bytes) -> None:
+        if ts not in self._waiters:
+            return
+        # hold the task reference (GC can cancel unreferenced tasks) and
+        # consume its exception (a transport closed during the backoff
+        # must not surface as 'exception was never retrieved')
+        task = asyncio.get_running_loop().create_task(
+            self.transport.broadcast(raw, self.cfg.replica_ids)
+        )
+        self._bg_tasks.add(task)
+
+        def _consume(t: asyncio.Task) -> None:
+            self._bg_tasks.discard(t)
+            if not t.cancelled():
+                t.exception()
+
+        task.add_done_callback(_consume)
 
     async def submit(self, operation: str, retries: int = 3) -> str:
         """Submit one operation; return the f+1-matched result.
@@ -177,14 +217,15 @@ class Client:
         raw = req.to_wire()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._waiters[ts] = fut
+        self._inflight_raw[ts] = raw
         try:
             # first attempt: primary (+ hedged backups); afterwards:
             # broadcast (classic PBFT retransmission — backups forward to
             # the primary and arm view-change timers)
             primary = self.cfg.primary(self.view_hint)
             await self.transport.send(primary, raw)
-            if self.hedge:
-                ids = self.cfg.replica_ids
+            ids = self.cfg.replica_ids
+            if self.hedge and len(ids) > 1:
                 start = ids.index(primary) if primary in ids else 0
                 for k in range(self.hedge):
                     # rotate targets per request so hedged load spreads
@@ -205,3 +246,5 @@ class Client:
         finally:
             self._waiters.pop(ts, None)
             self._replies.pop(ts, None)
+            self._inflight_raw.pop(ts, None)
+            self._mixed_retry_done.discard(ts)

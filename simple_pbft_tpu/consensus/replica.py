@@ -961,7 +961,16 @@ class Replica:
         """Answer with Reply.superseded=1 (see messages.Reply): the
         client library surfaces f+1 of these as SupersededError —
         resubmitting is the APPLICATION's call (the op may have executed
-        before the fold, so a blind auto-retry could double-apply)."""
+        before the fold, so a blind auto-retry could double-apply).
+
+        Transient split: while a checkpoint fold propagates, replicas
+        that folded answer superseded=1 here while slower ones still
+        re-send the cached real reply, so neither (result, superseded)
+        pair may reach the client's f+1 until stabilization (which needs
+        2f+1, so it always completes). "Identical on every honest
+        replica" holds for the snapshot state at quiescence, not during
+        the fold window — the client treats a mixed split as a cue to
+        rebroadcast early (client._on_reply) rather than a timeout."""
         reply = Reply(
             view=view,
             seq=seq,

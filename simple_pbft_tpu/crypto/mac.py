@@ -90,9 +90,13 @@ class MacBank:
         self._keys: Dict[str, Optional[bytes]] = {}
 
     def key_for(self, peer_id: str) -> Optional[bytes]:
-        if peer_id not in self._keys:
-            pub = self._kx_pubkeys.get(peer_id)
-            self._keys[peer_id] = (
-                shared_key(self._seed, pub) if pub is not None else None
-            )
+        if peer_id in self._keys:
+            return self._keys[peer_id]
+        pub = self._kx_pubkeys.get(peer_id)
+        if pub is None:
+            # unknown peer: answer None WITHOUT caching it — arbitrary
+            # hostile peer_ids must not grow this dict (the derived-key
+            # cache is bounded by the deployment's kx table instead)
+            return None
+        self._keys[peer_id] = shared_key(self._seed, pub)
         return self._keys[peer_id]

@@ -103,21 +103,27 @@ class NativeEdVerifier:
             raise ImportError("native ed25519 library unavailable")
         self._native = native
         self._np = np
-        # pubkey bytes -> (index into the affine bank) | None (bad point).
+        # pubkey bytes -> (64,) uint8 affine row x||y | None (bad point).
+        # Bounded: committee keys land early and stay; once MAX_KEYS
+        # distinct keys have been seen (adversarial client-key churn),
+        # later keys are decompressed per batch instead of cached, so a
+        # long-lived replica's memory stays O(MAX_KEYS) (this backend is
+        # the default CPU verifier — an unbounded map here was a leak).
         # Locked: the replica pipeline overlaps consecutive sweeps'
-        # verifies in separate executor threads, and an unlocked
-        # check-then-append could permanently map one key to another's
-        # bank row (every later signature from it failing).
+        # verifies in separate executor threads, and dict reads racing
+        # inserts need the mutation serialized.
         import threading
 
         self._key_lock = threading.Lock()
-        self._key_index: dict = {}
-        self._bank_rows: list = []  # (64,) uint8 rows: x||y little-endian
+        self._row_cache: dict = {}
 
-    def _key_for(self, pubkey: bytes):
+    MAX_KEYS = 8192  # ~0.5 MiB of rows; SIG_CACHE_MAX-style bound
+
+    def _row_for(self, pubkey: bytes):
+        """Affine bank row for a pubkey, or None for a bad point."""
         with self._key_lock:
-            if pubkey in self._key_index:
-                return self._key_index[pubkey]
+            if pubkey in self._row_cache:
+                return self._row_cache[pubkey]
         # decompression (exact bigint math) runs outside the lock; a
         # racing duplicate computation is harmless, the insert re-checks
         pt = (
@@ -134,13 +140,9 @@ class NativeEdVerifier:
                 dtype=self._np.uint8,
             )
         with self._key_lock:
-            if pubkey not in self._key_index:
-                if row is None:
-                    self._key_index[pubkey] = None
-                else:
-                    self._key_index[pubkey] = len(self._bank_rows)
-                    self._bank_rows.append(row)
-            return self._key_index[pubkey]
+            if len(self._row_cache) < self.MAX_KEYS:
+                self._row_cache.setdefault(pubkey, row)
+        return row
 
     def verify_batch(self, items: Sequence[BatchItem]) -> List[bool]:
         np = self._np
@@ -153,6 +155,11 @@ class NativeEdVerifier:
         a_enc = np.zeros((n, 32), dtype=np.uint8)
         precheck = np.zeros(n, dtype=np.uint8)
         msgs: List[bytes] = []
+        # per-batch bank, deduped by pubkey: the library rebuilds w-NAF
+        # tables per call, so the cost must scale with the batch's
+        # distinct signers, not with every key ever seen
+        local_idx: dict = {}
+        bank_rows: list = []
         for i, it in enumerate(items):
             msgs.append(it.msg)
             if len(it.sig) != 64 or len(it.pubkey) != 32:
@@ -160,26 +167,25 @@ class NativeEdVerifier:
             s_int = int.from_bytes(it.sig[32:], "little")
             if s_int >= ed25519_cpu.L:  # malleable S: reject (RFC 8032)
                 continue
-            idx = self._key_for(it.pubkey)
-            if idx is None:
+            j = local_idx.get(it.pubkey, -1)  # -1 = first sighting
+            if j == -1:
+                row = self._row_for(it.pubkey)
+                if row is None:
+                    local_idx[it.pubkey] = None  # bad point: remember
+                    continue
+                j = local_idx[it.pubkey] = len(bank_rows)
+                bank_rows.append(row)
+            elif j is None:  # seen this batch, known-bad point
                 continue
-            key_idx[i] = idx
+            key_idx[i] = j
             s_sc[i] = np.frombuffer(it.sig[32:], dtype=np.uint8)
             r_wire[i] = np.frombuffer(it.sig[:32], dtype=np.uint8)
             a_enc[i] = np.frombuffer(it.pubkey, dtype=np.uint8)
             precheck[i] = 1
         k_sc = self._native.challenge_batch(r_wire, a_enc, msgs)
-        # ship only the keys THIS batch references (remapped indices):
-        # the library rebuilds w-NAF tables per call, so the cost must
-        # scale with the batch's distinct signers, not the whole bank
-        used = sorted({int(k) for k in key_idx if k >= 0})
-        remap = {k: i for i, k in enumerate(used)}
-        key_idx = np.array(
-            [remap.get(int(k), -1) for k in key_idx], dtype=np.int32
-        )
         bank = (
-            np.stack([self._bank_rows[k] for k in used])
-            if used
+            np.stack(bank_rows)
+            if bank_rows
             else np.zeros((0, 64), dtype=np.uint8)
         )
         out = self._native.ed25519_batch_verify(

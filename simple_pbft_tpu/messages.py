@@ -208,12 +208,15 @@ class Message:
             d = json.loads(raw)
         except (json.JSONDecodeError, UnicodeDecodeError, RecursionError) as e:
             raise ValueError(f"undecodable message: {e}") from None
-        # The nesting walk exists to keep later canonical re-encodes
-        # (signing/digest paths) clear of the C encoder's ~1000-frame
-        # recursion limit. JSON depth is bounded by bytes/2, so frames
-        # this small can't get near it — skip the walk on the hot path
-        # (typed field validation in _build still applies in full).
-        msg = Message.from_dict(d, _depth_checked=len(raw) <= 1500)
+        # The nesting walk bounds depth at MAX_NESTING for EVERY frame.
+        # A small-frame skip once lived here (deep-but-small packets
+        # can't crash CPython >= 3.12's C encoder), but any skip makes
+        # message validity size- and version-dependent: a <=1500-byte
+        # ViewChange smuggling a >16-deep subtree would be accepted
+        # here, then rejected by every backup once embedded in a larger
+        # NewView — a re-poisonable view-change stall. The walk is
+        # iterative and O(parsed nodes), so small frames pay ~nothing.
+        msg = Message.from_dict(d)
         if len(raw) > type(msg).MAX_WIRE_BYTES:
             raise ValueError("message too large for its type")
         return msg
