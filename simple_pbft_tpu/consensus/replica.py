@@ -49,6 +49,7 @@ from ..messages import (
     Commit,
     Message,
     NewView,
+    NewViewFetch,
     PrePrepare,
     Prepare,
     QuorumCert,
@@ -109,7 +110,11 @@ class Replica:
         # exact executed timestamps (with their replies) above it.
         self.client_watermark: Dict[str, int] = {}
         self.recent_replies: Dict[str, Dict[int, Reply]] = {}
-        self.committed_log: List[Tuple[int, str]] = []  # (seq, digest) > h
+        # seq -> digest for executed blocks above the stable watermark
+        # (safety audits, slot-fetch block refill); insertion-ordered by
+        # execution. The reference's append-only CommittedMsgs
+        # (node.go:246) grew forever; this folds at each checkpoint.
+        self.committed_log: Dict[int, str] = {}
         # seq -> sender -> signed Checkpoint message (kept, not just the
         # digest: view-change certificates re-ship these as proof of h)
         self.checkpoints: Dict[int, Dict[str, Checkpoint]] = defaultdict(dict)
@@ -177,6 +182,12 @@ class Replica:
         self._mac = mac_mod.MacBank(seed, cfg.kx_pubkeys)
         # SlotFetch rate limiting: sender -> monotonic time last served
         self._slot_fetch_served: Dict[str, float] = {}
+        self._probe_rr = 0  # slot-probe target rotation
+        # the NEW-VIEW that installed our current view (view-sync serving)
+        self.last_new_view: Optional[NewView] = None
+        # highest seq with an observed commit certificate (committee
+        # liveness, independent of our own execution frontier)
+        self.max_committed_seen = 0
 
     def _auth_reply(self, reply: Reply) -> None:
         """Authenticate a reply: per-client HMAC when BOTH ends publish kx
@@ -267,6 +278,12 @@ class Replica:
             # detached re-issues awaiting a block fetch: if no peer ever
             # answers, the timer must fire and move the view again
             return True
+        # NOTE: ready-holes (later blocks parked behind an execution gap)
+        # deliberately do NOT count: they are LOCAL damage the slot probe
+        # repairs, and arming the failover timer on them synchronizes
+        # stalled replicas into f+1 join cascades — measured at n=64/QC
+        # with 2% drop: committee-wide failover thrash, throughput halved.
+        # The probe chain handles them via ViewChanger._probe's ready check.
         # only CURRENT-view proposals count: an orphan pre-prepare from a
         # dead view (primary crashed pre-quorum, O-set dropped the seq) is
         # abandoned work — counting it would arm the failover timer
@@ -460,7 +477,7 @@ class Replica:
             msg,
             (PrePrepare, Prepare, Commit, Checkpoint, ViewChange, NewView,
              QuorumCert, StateRequest, StateResponse, BlockFetch, BlockReply,
-             SlotFetch),
+             SlotFetch, NewViewFetch),
         ):
             if msg.sender not in self._replica_set:
                 return []
@@ -586,6 +603,8 @@ class Replica:
             await self._on_block_reply(msg)
         elif isinstance(msg, SlotFetch):
             await self._on_slot_fetch(msg)
+        elif isinstance(msg, NewViewFetch):
+            await self._on_new_view_fetch(msg)
         elif isinstance(msg, (ViewChange, NewView)):
             await self._on_view_message(msg)
         else:
@@ -690,13 +709,42 @@ class Replica:
     # ------------------------------------------------------------------
 
     async def _on_phase(self, msg) -> None:
-        if self.vc.in_view_change:
-            # between VIEW-CHANGE and NEW-VIEW a correct replica takes no
-            # part in the old view (Castro-Liskov); prepared state is
-            # already frozen into our VIEW-CHANGE certificate
-            self.metrics["dropped_in_viewchange"] += 1
-            return
+        frozen = self.vc.in_view_change
+        if frozen:
+            # Between VIEW-CHANGE and NEW-VIEW, PREPARED STATE must not
+            # change: the frozen P-set claim in our certificate is what
+            # makes stale VIEW-CHANGEs safe to count toward a later
+            # NEW-VIEW (quorum intersection — a frozen replica provably
+            # prepared nothing after its certificate). But EXECUTION may
+            # proceed: commitment is final in every view, so adopting a
+            # block for a slot that already holds a commit QC, or
+            # counting commits toward an already-prepared slot, only
+            # lets a locally-stalled replica catch up while frozen.
+            # Without this a replica whose view change the healthy
+            # committee never joins was deaf forever (the round-3
+            # qc-n64 chaos stall: replica_exec_min = 0). Prepares stay
+            # frozen; action lists are filtered to execution below.
+            if msg.view > self.view:
+                # a frozen replica especially needs the view-sync hint:
+                # traffic from a view ahead means the NEW-VIEW it is
+                # waiting for (or a later one) already exists
+                self.vc.note_higher_view(msg.view)
+            allow = (
+                not isinstance(msg, Prepare)
+                and msg.view == self.view
+                and self._in_window(msg.seq)
+            )
+            if allow and isinstance(msg, PrePrepare):
+                inst0 = self.instances.get((msg.view, msg.seq))
+                allow = inst0 is not None and inst0.commit_qc is not None
+            if not allow:
+                self.metrics["dropped_in_viewchange"] += 1
+                return
         if msg.view != self.view:
+            if msg.view > self.view:
+                # verified traffic from a view ahead of us: a NEW-VIEW we
+                # never received exists — the probe fetches it
+                self.vc.note_higher_view(msg.view)
             self.metrics["wrong_view"] += 1
             return
         if not self._in_window(msg.seq):
@@ -719,6 +767,9 @@ class Replica:
             actions = inst.on_prepare(msg)
         else:
             actions = inst.on_commit(msg)
+        if frozen:
+            # frozen catch-up: execution only, never new votes/preparedness
+            actions = [a for a in actions if isinstance(a, ExecuteBlock)]
         for act in actions:
             await self._perform(act)
         if (
@@ -814,6 +865,8 @@ class Replica:
             self.metrics["dropped_in_viewchange"] += 1
             return
         if msg.view != self.view:
+            if msg.view > self.view:
+                self.vc.note_higher_view(msg.view)
             self.metrics["wrong_view"] += 1
             return
         if not self._in_window(msg.seq):
@@ -855,7 +908,16 @@ class Replica:
                 self.metrics["stale_execute_dropped"] += 1
                 return
             self.ready[act.seq] = act
+            # committee-liveness signal (failover deferral): an
+            # ExecuteBlock action means a commit certificate formed for
+            # this seq, whether or not our ordered execution can reach it
+            if act.seq > self.max_committed_seen:
+                self.max_committed_seen = act.seq
             await self._execute_ready()
+            if self.ready:
+                # parked behind an execution hole: make sure the repair
+                # probe chain is running (independent of failover arming)
+                self.vc.ensure_probe()
 
     async def _send_vote(self, cls, phase: str, act) -> None:
         """Emit one phase vote. Normal mode: ed25519-signed broadcast to
@@ -891,7 +953,7 @@ class Replica:
         while (self.executed_seq + 1) in self.ready:
             act = self.ready.pop(self.executed_seq + 1)
             self.executed_seq += 1
-            self.committed_log.append((act.seq, act.digest))
+            self.committed_log[act.seq] = act.digest
             self.metrics["committed_blocks"] += 1
             src = self.instances.get((act.view, act.seq))
             if src is not None and src.t_started:
@@ -1259,6 +1321,7 @@ class Replica:
         release any buffered detached pre-prepares — but only for the
         CURRENT view: a late reply for a superseded view's digest must
         not clobber the current view's replay slot."""
+        qc_stalled = None  # digest -> commit-QC-stalled instances (lazy)
         for ent in msg.blocks[:256]:
             dg = ent.get("digest")
             block = ent.get("block")
@@ -1267,6 +1330,29 @@ class Replica:
             if PrePrepare.block_digest(block) != dg:
                 self.metrics["bad_block_reply"] += 1
                 continue
+            # hole repair: a slot whose digest a verified commit QC fixed
+            # but whose pre-prepare (and so block) never arrived adopts
+            # the digest-matching block directly and executes — votes are
+            # never emitted by adoption, so this is safe frozen or not.
+            # (stalled-slot index built once per reply, not per entry)
+            if qc_stalled is None:
+                qc_stalled = defaultdict(list)
+                for inst in self.instances.values():
+                    if (
+                        inst.commit_qc is not None
+                        and inst.block is None
+                        and inst.digest is not None
+                        and not inst.executed
+                    ):
+                        qc_stalled[inst.digest].append(inst)
+            for inst in qc_stalled.get(dg, ()):
+                if self._validate_block(block) is None:
+                    self.metrics["bad_block_reply"] += 1
+                    break
+                self.metrics["holes_repaired"] += 1
+                for act in inst.adopt_block(block):
+                    if isinstance(act, ExecuteBlock):
+                        await self._perform(act)
             waiters = self.block_pending.pop(dg, None)
             if not waiters:
                 continue
@@ -1302,6 +1388,10 @@ class Replica:
         for (v, s) in self.instances:
             if v == self.view and s > horizon:
                 horizon = max(horizon, s)
+        if self.ready:
+            # an executed-but-parked block beyond the hole proves the
+            # committee committed everything up to it
+            horizon = max(horizon, max(self.ready))
         horizon = min(horizon, self.executed_seq + self.MAX_SLOT_FETCH)
         return [
             s
@@ -1310,23 +1400,49 @@ class Replica:
         ]
 
     async def send_slot_probe(self) -> None:
-        """Ask the current primary to re-send stalled slots' artifacts.
-        Fired by the failover machinery at HALF the view timeout: a
-        dropped QC/pre-prepare then heals with one round trip instead of
-        a full view change."""
+        """Ask peers to re-send stalled slots' artifacts. Fired by the
+        failover machinery at a fraction of the view timeout — and KEPT
+        firing while frozen in a view change (a locally-stalled replica's
+        failover is never joined by a healthy committee; catch-up in the
+        current view is its only way back). A dropped QC/pre-prepare then
+        heals with one round trip instead of a view change. Targets
+        rotate beyond the primary: any executed replica can serve blocks
+        and self-certifying QCs, and under loss (or with a stalled
+        primary) the primary alone is a single point of repair failure."""
         seqs = self.missing_slots()
-        if not seqs or self.vc.in_view_change:
+        view_hint = self.vc.pending_view_hint()
+        if not seqs and not view_hint:
             return
-        fetch = SlotFetch(view=self.view, seqs=seqs)
-        self.signer.sign_msg(fetch)
-        self.metrics["slot_probes_sent"] += 1
-        await self.transport.send(
-            self.cfg.primary(self.view), fetch.to_wire()
-        )
+        peers = [r for r in self.cfg.replica_ids if r != self.id]
+        rotating = peers[self._probe_rr % len(peers)] if peers else None
+        self._probe_rr += 1
+        if seqs:
+            fetch = SlotFetch(view=self.view, seqs=seqs)
+            self.signer.sign_msg(fetch)
+            self.metrics["slot_probes_sent"] += 1
+            targets = dict.fromkeys([self.cfg.primary(self.view), rotating])
+            for t in targets:
+                if t is not None and t != self.id:
+                    await self.transport.send(t, fetch.to_wire())
+        if view_hint:
+            # verified traffic from a higher view: fetch the NEW-VIEW we
+            # lost (its primary surely has it; the rotating peer covers a
+            # crashed primary)
+            nvf = NewViewFetch(view=view_hint)
+            self.signer.sign_msg(nvf)
+            self.metrics["newview_fetches_sent"] += 1
+            self.vc.count_hint_fetch()
+            targets = dict.fromkeys([self.cfg.primary(view_hint), rotating])
+            for t in targets:
+                if t is not None and t != self.id:
+                    await self.transport.send(t, nvf.to_wire())
 
     async def _on_slot_fetch(self, msg: SlotFetch) -> None:
-        if msg.view != self.view or not isinstance(msg.seqs, list):
+        if not isinstance(msg.seqs, list):
             return
+        # no view gate: instance-artifact lookups key on the REQUESTER's
+        # view (a mismatch just misses), and executed blocks are
+        # view-independent and self-authenticating either way
         now = time.monotonic()
         last = self._slot_fetch_served.get(msg.sender, 0.0)
         if now - last < self.SLOT_FETCH_COOLDOWN:
@@ -1334,25 +1450,59 @@ class Replica:
             return
         self._slot_fetch_served[msg.sender] = now
         served = 0
+        blocks: List[Dict[str, Any]] = []
+        approx = 0
         for seq in msg.seqs[: self.MAX_SLOT_FETCH]:
             if not isinstance(seq, int):
-                return
+                break  # malformed entry: still flush what we gathered
             inst = self.instances.get((msg.view, seq))
-            if inst is None:
-                continue
-            if inst.pre_prepare is not None and inst.pre_prepare.block:
-                await self.transport.send(
-                    msg.sender, inst.pre_prepare.to_wire()
-                )
-                served += 1
-            # QC mode: the aggregates are the quorum; re-send our stored
-            # copies (self-certifying — any replica may relay them)
-            for qc in (inst.prepare_qc, inst.commit_qc):
-                if qc is not None:
-                    await self.transport.send(msg.sender, qc.to_wire())
+            if inst is not None:
+                if inst.pre_prepare is not None and inst.pre_prepare.block:
+                    await self.transport.send(
+                        msg.sender, inst.pre_prepare.to_wire()
+                    )
                     served += 1
+                # QC mode: the aggregates are the quorum; re-send our
+                # stored copies (self-certifying — any replica may relay)
+                for qc in (inst.prepare_qc, inst.commit_qc):
+                    if qc is not None:
+                        await self.transport.send(msg.sender, qc.to_wire())
+                        served += 1
+            if inst is None or inst.pre_prepare is None:
+                # block refill regardless of the instance's view: a hole
+                # whose digest a commit QC fixed only needs the BLOCK to
+                # execute, and a BlockReply entry authenticates itself by
+                # digest (see _on_block_reply's adopt_block path)
+                dg = self.committed_log.get(seq)
+                ent = self.block_store.get(dg) if dg is not None else None
+                if ent is not None:
+                    blocks.append({"digest": dg, "block": ent[1]})
+                    approx += sum(len(str(rd)) for rd in ent[1]) + 128
+                    served += 1
+                    if approx >= self.BLOCK_REPLY_SOFT_BYTES:
+                        await self._send_block_reply(msg.sender, blocks)
+                        blocks, approx = [], 0
+        if blocks:
+            await self._send_block_reply(msg.sender, blocks)
         if served:
             self.metrics["slot_fetches_served"] += 1
+
+    async def _on_new_view_fetch(self, msg: NewViewFetch) -> None:
+        """Re-send the retained NEW-VIEW certificate (original primary
+        signature and embedded proofs intact — the requester validates it
+        exactly like the broadcast). Cooldown-bounded per sender: the
+        certificate can be large."""
+        nv = self.last_new_view
+        if nv is None or msg.view <= 0 or nv.new_view < msg.view:
+            return
+        now = time.monotonic()
+        key = f"nv:{msg.sender}"
+        if now - self._slot_fetch_served.get(key, 0.0) < self.SLOT_FETCH_COOLDOWN:
+            self.metrics["slot_fetch_throttled"] += 1
+            return
+        self._slot_fetch_served[key] = now
+        self.metrics["newview_fetches_served"] += 1
+        await self.transport.send(msg.sender, nv.to_wire())
 
     async def _on_state_request(self, msg: StateRequest) -> None:
         snap = self.snapshots.get(msg.seq)
@@ -1367,6 +1517,17 @@ class Replica:
             return
         seq, digest = self.pending_sync
         if msg.seq != seq:
+            return
+        if seq <= self.executed_seq:
+            # we outran the sync while the response was in flight (hole
+            # repair raced state transfer): applying it now would REGRESS
+            # executed_seq below blocks already popped from `ready` —
+            # leaving execution wedged at the checkpoint forever (and
+            # double-applying the app state). Measured under 2% chaos at
+            # n=64: replicas frozen at exec == checkpoint seq with later
+            # instances marked executed but never applied.
+            self.pending_sync = None
+            self.metrics["state_sync_obsolete"] += 1
             return
         from ..app import snapshot_digest
 
@@ -1436,9 +1597,9 @@ class Replica:
         self.snapshots = {
             s: d for s, d in self.snapshots.items() if s >= seq
         }
-        self.committed_log = [
-            (s, d) for (s, d) in self.committed_log if s > seq
-        ]
+        self.committed_log = {
+            s: d for s, d in self.committed_log.items() if s > seq
+        }
         self.ready = {s: a for s, a in self.ready.items() if s > seq}
         self.vc_replay = {
             s: pp for s, pp in self.vc_replay.items() if s > seq

@@ -255,6 +255,22 @@ class Instance:
             )
         return out
 
+    # -- hole repair ---------------------------------------------------------
+
+    def adopt_block(self, block: List[Dict[str, Any]]) -> List[Action]:
+        """Catch-up refill: a self-authenticating block (its digest must
+        match the digest a verified quorum certificate fixed for this
+        slot) for a slot whose pre-prepare was never delivered — the
+        steady-state hole SlotFetch repairs (replica._on_block_reply).
+        Never overrides an admitted block; emits at most the execution
+        transition (no votes)."""
+        if self.block is not None or self.digest is None:
+            return []
+        if PrePrepare.block_digest(block) != self.digest:
+            return []
+        self.block = block
+        return self._maybe_advance_qc() if self.qc_mode else []
+
     # -- view-change support -------------------------------------------------
 
     def _detached_pre_prepare(self) -> Dict[str, Any]:

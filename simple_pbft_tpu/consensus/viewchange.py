@@ -343,6 +343,16 @@ class ViewChanger:
         self._vc_task: Optional[asyncio.Task] = None
         self._timeout = replica.cfg.view_timeout
         self._nv_granted: set = set()  # views granted a NEW-VIEW window
+        # failover deferral (see _expired): progress markers at arm time
+        # and the backlog head at the last deferral
+        self._armed_exec = -1
+        self._armed_committed = -1
+        self._deferred_key = None
+        self._target_expiries = 0  # expiries while frozen at one target
+        # highest view seen in signature-verified traffic (bounded by
+        # MAX_VIEWS_AHEAD) — evidence a NEW-VIEW we never received exists
+        self._view_hint = 0
+        self._hint_fetches = 0
 
     # -- timers ---------------------------------------------------------
 
@@ -354,6 +364,8 @@ class ViewChanger:
         with one SlotFetch round trip instead of a view change."""
         if self._timer is None and self.r.cfg.view_timeout > 0:
             loop = asyncio.get_running_loop()
+            self._armed_exec = self.r.executed_seq
+            self._armed_committed = self.r.max_committed_seen
             self._timer = loop.call_later(self._timeout, self._expired)
             if self._probe_timer is None:
                 self._probe_timer = loop.call_later(
@@ -382,9 +394,31 @@ class ViewChanger:
             self._probe_timer.cancel()
             self._probe_timer = None
 
+    def ensure_probe(self) -> None:
+        """Start the repair-probe chain if it is idle. Called whenever a
+        block parks in `ready` behind an execution hole: hole repair must
+        not depend on the FAILOVER timer being armed (a backup that
+        relays no client work never arms it, yet can still lose frames —
+        and arming failover on local holes causes join cascades)."""
+        if self._probe_timer is None and self.r.cfg.view_timeout > 0:
+            self._probe_timer = asyncio.get_running_loop().call_later(
+                max(0.25, self._timeout / 4), self._probe
+            )
+
     def _probe(self) -> None:
         self._probe_timer = None
-        if not self.r.has_outstanding_work() or self.in_view_change:
+        # Keep probing WHILE FROZEN in a view change too: a replica whose
+        # stall was local (dropped QCs/pre-prepares, not a dead primary)
+        # fires a view change the healthy committee never joins — its only
+        # way back is catching up in the current view, and execution from
+        # commit certificates is final in every view. Before round 4 the
+        # in-view-change gate here made such replicas permanently deaf
+        # (the qc-n64 chaos near-stall: replica_exec_min = 0).
+        if not (
+            self.r.has_outstanding_work()
+            or self.r.ready
+            or self.pending_view_hint()
+        ):
             return
         # retain the task (a bare ensure_future can be collected mid-send)
         self._probe_task = asyncio.ensure_future(self.r.send_slot_probe())
@@ -399,14 +433,121 @@ class ViewChanger:
 
     def _expired(self) -> None:
         self._timer = None
-        if not self.r.has_outstanding_work():
+        r = self.r
+        if not r.has_outstanding_work():
             return
+        # Failover deferral: PBFT's timeout policy assumes a stall means
+        # a faulty primary, because the paper's blanket retransmission
+        # makes per-replica loss invisible. This framework repairs local
+        # loss with targeted slot probes instead — so when EXECUTION HAS
+        # ADVANCED since the timer was armed (the committee is live) and
+        # the head of our backlog is not stuck (no censorship), a local
+        # stall must be repaired, not escalated: unilateral view changes
+        # under lossy links synchronize into f+1 join cascades and tear
+        # down healthy views (measured at n=64/QC with 2% drop). The
+        # same backlog head surviving two consecutive deferrals is the
+        # censorship signal that restores the classic escalation: a
+        # live committee that will not execute OUR client's request is
+        # exactly what a view change exists to fix.
+        if not self.in_view_change and (
+            r.executed_seq > self._armed_exec
+            or r.max_committed_seen > self._armed_committed
+        ):
+            # A LOCAL stall (execution hole behind observed commits, or
+            # parked ready blocks) fully explains a stuck backlog head,
+            # so it defers unconditionally — the probes are repairing it,
+            # and escalating would punish a live committee for our loss.
+            # Otherwise the same head surviving two consecutive deferrals
+            # means the live committee will not execute OUR work:
+            # censorship, the case the view change exists for.
+            stalled_locally = bool(r.ready) or (
+                r.executed_seq < r.max_committed_seen
+            )
+            key = self._backlog_head()
+            if stalled_locally or key is None or key != self._deferred_key:
+                self._deferred_key = key
+                r.metrics["failover_deferred"] += 1
+                self.arm()  # re-arm at the current (un-backed-off) timeout
+                return
+        self._deferred_key = None
+        if self.in_view_change:
+            self._target_expiries += 1
+            if self._target_expiries % 2 == 1:
+                # First expiry at this target: RETRANSMIT the VIEW-CHANGE
+                # for the SAME view instead of escalating — the broadcast
+                # itself is lossy, and unilateral +1 laddering outruns the
+                # view the committee actually installs, so the eventual
+                # NEW-VIEW gets rejected below-target and the replica is
+                # marooned frozen (measured at n=64/2% drop: 486
+                # below-target rejections, share quorum eroded to a
+                # committee-wide stall). Escalate only every second
+                # expiry, with the usual timeout doubling in between.
+                r.metrics["view_change_resent"] += 1
+                self._timeout = min(self._timeout * 2, 60.0)
+                self._timer = asyncio.get_running_loop().call_later(
+                    self._timeout, self._expired
+                )
+                self._vc_task = asyncio.ensure_future(
+                    self.resend_view_change()
+                )
+                self._vc_task.add_done_callback(
+                    lambda _t: setattr(self, "_vc_task", None)
+                )
+                return
+        self._target_expiries = 0
         # retain the task: a bare ensure_future is only weakly referenced
         # by the loop and can be collected mid-broadcast
         self._vc_task = asyncio.ensure_future(
-            self.start_view_change(max(self.target_view, self.r.view) + 1)
+            self.start_view_change(max(self.target_view, r.view) + 1)
         )
         self._vc_task.add_done_callback(lambda _t: setattr(self, "_vc_task", None))
+
+    def _backlog_head(self):
+        """Oldest outstanding client work, as a stable identity: relay
+        and pending buffers are insertion-ordered, so their first keys
+        are the longest-waiting requests."""
+        r = self.r
+        k = next(iter(r.relay_buffer), None)
+        if k is not None:
+            return ("relay", k)
+        if r.pending_requests:
+            req = r.pending_requests[0]
+            return ("pend", (req.client_id, req.timestamp))
+        return None
+
+    # -- view sync ------------------------------------------------------
+
+    MAX_HINT_FETCHES = 8  # unanswered NewViewFetch rounds per hint
+
+    def note_higher_view(self, v: int) -> None:
+        """Signature-verified traffic from view v > ours: remember it as
+        evidence a NEW-VIEW exists that we never received (the probe
+        fetches it — replica.send_slot_probe). Starts the probe chain:
+        a quiescent replica (no outstanding work, no parked blocks) that
+        lost the one NEW-VIEW frame would otherwise never fetch it."""
+        if self.r.view < v <= self.r.view + self.MAX_VIEWS_AHEAD:
+            if v > self._view_hint:
+                self._view_hint = v
+                self._hint_fetches = 0
+            self.ensure_probe()
+
+    def pending_view_hint(self) -> int:
+        """The view to fetch a NEW-VIEW for, or 0. Expires after
+        MAX_HINT_FETCHES unanswered rounds: a single forged higher-view
+        message from a faulty replica must not fuel fetch traffic
+        forever (a genuine NEW-VIEW answers within a round or two; fresh
+        evidence re-arms the counter via note_higher_view)."""
+        if self._view_hint <= self.r.view:
+            self._view_hint = 0
+            return 0
+        if self._hint_fetches >= self.MAX_HINT_FETCHES:
+            self._view_hint = 0
+            return 0
+        return self._view_hint
+
+    def count_hint_fetch(self) -> None:
+        """A NewViewFetch for the current hint actually went out."""
+        self._hint_fetches += 1
 
     # -- initiating -----------------------------------------------------
 
@@ -418,6 +559,7 @@ class ViewChanger:
             return
         self.in_view_change = True
         self.target_view = new_view
+        self._target_expiries = 0
         self.r.metrics["view_changes_started"] += 1
         # exponential backoff: if this view change stalls, suspect further
         self._timeout = min(self._timeout * 2, 60.0)
@@ -425,6 +567,12 @@ class ViewChanger:
             loop = asyncio.get_running_loop()
             self.cancel()
             self._timer = loop.call_later(self._timeout, self._expired)
+            # the recovery probe keeps running while frozen (see _probe:
+            # catch-up in the current view is a frozen replica's only way
+            # back when the committee never joins its view change)
+            self._probe_timer = loop.call_later(
+                max(0.5, self._timeout / 4), self._probe
+            )
 
         await self.r.ensure_checkpoint_qc()  # QC mode: one aggregate for h
         vc = self.build_view_change(new_view)
@@ -450,6 +598,18 @@ class ViewChanger:
         )
         await self.r.transport.broadcast(wire, self.r.cfg.replica_ids)
         await self.on_view_change(vc)  # count our own
+
+    async def resend_view_change(self) -> None:
+        """Rebuild and rebroadcast our VIEW-CHANGE for the CURRENT target
+        (timer expiry while frozen — see _expired). The prepared state is
+        frozen so the P-set is unchanged; the checkpoint proof may be
+        fresher, which only helps the new primary."""
+        if not self.in_view_change:
+            return
+        await self.r.ensure_checkpoint_qc()
+        vc = self.build_view_change(self.target_view)
+        self.r.signer.sign_msg(vc)
+        await self.r.transport.broadcast(vc.to_wire(), self.r.cfg.replica_ids)
 
     def build_view_change(self, new_view: int) -> ViewChange:
         r = self.r
@@ -699,6 +859,7 @@ class ViewChanger:
         r.view = new_view
         self.in_view_change = False
         self.target_view = new_view
+        self._target_expiries = 0
         self.vc_store = {v: s for v, s in self.vc_store.items() if v > new_view}
         # NOTE: the backoff timeout is deliberately NOT reset here — only
         # actual request progress resets it (reset() via _execute_ready).
@@ -722,6 +883,9 @@ class ViewChanger:
         self._timeout = min(max(self._timeout, 3 * base), 60.0)
         self._rearm_only()
         r.metrics["views_installed"] += 1
+        # retain the certificate: peers that lost the one NEW-VIEW
+        # broadcast re-fetch it from us (messages.NewViewFetch)
+        r.last_new_view = nv
         # old views' QC-sender mute counters are moot once the view moves;
         # on_qc only records failures for the CURRENT view, so every key
         # is from a view < new_view — clear the lot

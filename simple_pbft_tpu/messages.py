@@ -535,6 +535,23 @@ class SlotFetch(Message):
     seqs: List[int] = field(default_factory=list)
 
 
+@dataclass
+class NewViewFetch(Message):
+    """Ask a peer to re-send the NEW-VIEW certificate that installed a
+    view >= ``view``. Signature-verified traffic from a higher view is
+    proof such a certificate exists, but the NEW-VIEW broadcast itself
+    is sent once — a replica that loses that one frame is marooned in a
+    dead view until the next full failover (measured at n=64 under 2%
+    drop: a committee split across views for the rest of the run). The
+    reply is the original NEW-VIEW message, still carrying its primary's
+    envelope signature and embedded certificates, so the requester
+    validates it exactly like the broadcast (viewchange.on_new_view)."""
+
+    KIND: ClassVar[str] = "newviewfetch"
+
+    view: int = 0
+
+
 EMPTY_BLOCK_DIGEST = PrePrepare.block_digest([])
 
 ALL_KINDS = tuple(sorted(_REGISTRY))

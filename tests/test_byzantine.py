@@ -120,7 +120,7 @@ def test_equivocating_primary_safety_and_liveness():
             # SAFETY: one digest per committed seq across honest replicas
             by_seq = {}
             for r in honest:
-                for seq, digest in r.committed_log:
+                for seq, digest in r.committed_log.items():
                     by_seq.setdefault(seq, set()).add(digest)
                 for s, d in r.checkpoint_digests.items():
                     by_seq.setdefault(("ckpt", s), set()).add(d)

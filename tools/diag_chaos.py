@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Diagnose the qc-n64 chaos near-stall (VERDICT round-3 weak #3).
+
+Reproduces the committed scenario (n=64 QC mode, 2% drop / 30 ms delay /
+1% dup, seed 42) at a shorter duration and dumps per-replica stall
+state: executed_seq, the first hole, what the hole's instance is
+missing, slot-probe / slot-fetch / state-sync counters, and view-change
+activity. Run on CPU:
+
+    JAX_PLATFORMS=cpu python tools/diag_chaos.py [--n 64] [--seconds 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def main(n: int, seconds: float, qc: bool, drop: float) -> None:
+    from simple_pbft_tpu.committee import LocalCommittee
+    from simple_pbft_tpu.transport.local import FaultPlan
+
+    plan = FaultPlan(
+        drop_rate=drop, delay_range=(0.0, 0.03), duplicate_rate=0.01, seed=42
+    )
+    com = LocalCommittee.build(
+        n=n,
+        clients=8,
+        fault_plan=plan,
+        max_batch=256,
+        view_timeout=3.0,
+        checkpoint_interval=64,
+        watermark_window=1024,
+        qc_mode=qc,
+    )
+    for c in com.clients:
+        c.request_timeout = 4.5
+        c.hedge = 2
+    com.start()
+
+    stop_at = time.perf_counter() + seconds
+    done = errors = 0
+
+    async def pump(client, k):
+        nonlocal done, errors
+        i = 0
+        while time.perf_counter() < stop_at:
+            try:
+                await client.submit(f"put k{k}_{i % 64} {i}", retries=8)
+                done += 1
+            except Exception:
+                errors += 1
+            i += 1
+
+    pumps = [
+        asyncio.create_task(pump(c, j)) for j, c in enumerate(com.clients)
+        for _ in range(16)
+    ]
+    await asyncio.gather(*pumps, return_exceptions=True)
+
+    print(f"\n=== committed={done} errors={errors} over {seconds}s "
+          f"({done / seconds:.1f} req/s)")
+    interesting = (
+        "committed_requests", "slot_probes_sent", "slot_fetches_served",
+        "slot_fetch_throttled", "state_sync_requests", "bad_qc",
+        "wrong_view", "out_of_window", "dropped_in_viewchange",
+        "vote_suppressed_in_vc", "view_changes", "dropped_precheck",
+        "stale_execute_dropped", "blocks_fetched", "bad_sig",
+        "failover_deferred", "view_changes_started", "views_installed",
+        "newview_fetches_sent", "newview_fetches_served",
+        "holes_repaired", "newview_below_target",
+    )
+    agg = {k: 0 for k in interesting}
+    rows = []
+    for r in com.replicas:
+        for k in interesting:
+            agg[k] += r.metrics.get(k, 0)
+        rows.append(r)
+    print("aggregate:", {k: v for k, v in agg.items() if v})
+    views = sorted(set(r.view for r in rows))
+    print(f"views: {views}")
+
+    rows.sort(key=lambda r: r.executed_seq)
+    print("\nper-replica stall detail (5 most stalled + median + best):")
+    sample = rows[:5] + [rows[len(rows) // 2], rows[-1]]
+    for r in sample:
+        hole = r.executed_seq + 1
+        inst = None
+        for (v, s), i in r.instances.items():
+            if s == hole and (inst is None or v > inst.view):
+                inst = i
+        miss = "no-instance"
+        if inst is not None:
+            miss = (
+                f"stage={inst.stage.name}"
+                f" pp={'y' if inst.pre_prepare is not None else 'N'}"
+                f" blk={'y' if inst.block is not None else 'N'}"
+                f" pqc={'y' if inst.prepare_qc is not None else 'N'}"
+                f" cqc={'y' if inst.commit_qc is not None else 'N'}"
+                f" prep={len(inst.prepares)} com={len(inst.commits)}"
+            )
+        print(
+            f"  {r.id}: exec={r.executed_seq} stable={r.stable_seq} "
+            f"view={r.view} ready={len(r.ready)} "
+            f"ready_range={[min(r.ready), max(r.ready)] if r.ready else []} "
+            f"hole@{hole}: {miss} "
+            f"probes={r.metrics.get('slot_probes_sent', 0)} "
+            f"served={r.metrics.get('slot_fetches_served', 0)} "
+            f"outstanding={r.has_outstanding_work()} "
+            f"in_vc={r.vc.in_view_change} timer={'y' if r.vc._timer else 'N'}"
+        )
+    await com.stop()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=64)
+    ap.add_argument("--seconds", type=float, default=20.0)
+    ap.add_argument("--no-qc", action="store_true")
+    ap.add_argument("--drop", type=float, default=0.02)
+    args = ap.parse_args()
+    asyncio.run(main(args.n, args.seconds, not args.no_qc, args.drop))
