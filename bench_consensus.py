@@ -231,6 +231,15 @@ async def run_config(
     exec_counts = sorted(
         r.metrics.get("committed_requests", 0) for r in com.replicas if r._running
     )
+    # designated-replier fan-out: replies transmitted per committed
+    # request committee-wide (cfg.repliers = f+1 plus loss spares;
+    # everything beyond f+1 is deliberate redundancy, everything under
+    # n is the rotation's savings vs reply-from-everyone)
+    # (surviving replicas only, matching exec_counts — a crashed
+    # replica's pre-crash replies would otherwise inflate the ratio)
+    replies_sent = sum(
+        r.metrics.get("replies_sent", 0) for r in com.replicas if r._running
+    )
     if storm:
         # certificate-size evidence: the qc_mode claim is smaller failover
         # certificates — report the biggest ones actually built
@@ -267,6 +276,11 @@ async def run_config(
         "client_timeouts": len(errors),
         "replica_exec_min": exec_counts[0] if exec_counts else 0,
         "replica_exec_max": exec_counts[-1] if exec_counts else 0,
+        "replies_sent": replies_sent,
+        "reply_fanout": round(
+            replies_sent / max(1, exec_counts[-1] if exec_counts else 1), 1
+        ),
+        "repliers_cfg": com.cfg.repliers,
         "vs_reference_req_s": round(committed / window / 0.4, 1),  # ref ~0.4/s
     }
     rec.update(crash_info)

@@ -1014,6 +1014,7 @@ class Replica:
                 # (the liveness fallback).
                 if (self._index - act.seq) % self.cfg.n < self.cfg.repliers:
                     self._auth_reply(reply)
+                    self.metrics["replies_sent"] += 1
                     await self.transport.send(req.client_id, reply.to_wire())
             if self.executed_seq % self.cfg.checkpoint_interval == 0:
                 await self._emit_checkpoint(self.executed_seq)

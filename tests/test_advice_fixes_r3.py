@@ -102,6 +102,7 @@ def test_native_verifier_row_cache_bounded():
     except ImportError:
         pytest.skip("native ed25519 library unavailable")
     v.MAX_KEYS = 4  # shadow the class bound for the test
+    v._row_cache.clear()  # process-wide cache: isolate from other tests
     items = []
     for i in range(10):
         seed = bytes([i + 1]) * 32

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""CPU budget of a committed request (VERDICT round-3 next-round #7).
+
+Runs a LocalCommittee under cProfile and buckets every profiled
+CPU-millisecond into the categories that matter for "what buys the next
+10x toward 10k req/s": canonical JSON encode/decode, SHA-256 digesting,
+Ed25519 signing, signature verification, BLS/QC pairing work, MAC,
+asyncio/event-loop machinery, transport, and the rest. Prints a
+per-committed-request budget and a single JSON line for the record.
+
+    JAX_PLATFORMS=cpu python tools/profile_request.py --n 16 --seconds 15
+
+cProfile adds interpreter overhead (~1.5-2x wall); the RELATIVE split is
+the deliverable, plus an uninstrumented throughput anchor from
+bench_results/consensus_cpu_r04.jsonl.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import cProfile
+import io
+import json
+import os
+import pstats
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CATEGORIES = (
+    # (bucket, substrings matched against "file:func")
+    ("json_codec", ("json/encoder", "json/decoder", "canonical_json",
+                    "json.dumps", "to_wire", "to_dict", "from_dict",
+                    "from_wire", "signing_payload", "_check_depth")),
+    ("sha256_digest", ("sha256_hex", "block_digest", "snapshot_digest",
+                       "openssl_sha256", "_hashlib")),
+    ("ed25519_sign", ("signer.py:", "sign_msg", "ed25519_cpu.py:sign")),
+    ("sig_verify", ("verifier.py:", "_timed_verify", "challenge_batch",
+                    "ed25519_batch_verify", "_batch_items")),
+    ("bls_qc", ("bls.py:", "qc.py:", "bls381", "pairing", "sign_share")),
+    ("mac", ("mac.py:",)),
+    ("asyncio_loop", ("asyncio/", "selectors.py", "selector_events")),
+    ("transport", ("transport/",)),
+    ("consensus_logic", ("replica.py:", "state.py:", "viewchange.py:",
+                         "client.py:", "committee.py:")),
+)
+
+
+def bucket_of(key: str) -> str:
+    for name, pats in CATEGORIES:
+        if any(p in key for p in pats):
+            return name
+    return "other"
+
+
+async def load(n: int, seconds: float, qc: bool, clients: int, outstanding: int):
+    from simple_pbft_tpu.committee import LocalCommittee
+
+    com = LocalCommittee.build(
+        n=n, clients=clients, qc_mode=qc, view_timeout=30.0,
+        checkpoint_interval=64, watermark_window=1024,
+    )
+    for c in com.clients:
+        c.request_timeout = 30.0
+    com.start()
+    stop_at = time.perf_counter() + seconds
+    done = 0
+
+    async def pump(c, k):
+        nonlocal done
+        i = 0
+        while time.perf_counter() < stop_at:
+            await c.submit(f"put k{k}_{i % 64} {i}", retries=3)
+            done += 1
+            i += 1
+
+    per = max(1, outstanding // clients)
+    pumps = [
+        asyncio.get_event_loop().create_task(pump(c, j))
+        for j, c in enumerate(com.clients)
+        for _ in range(per)
+    ]
+    await asyncio.gather(*pumps, return_exceptions=True)
+    await com.stop()
+    return done
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=16)
+    ap.add_argument("--seconds", type=float, default=15.0)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--outstanding", type=int, default=128)
+    ap.add_argument("--qc", action="store_true")
+    ap.add_argument("--top", type=int, default=25)
+    args = ap.parse_args()
+
+    prof = cProfile.Profile()
+    t0 = time.perf_counter()
+    prof.enable()
+    done = asyncio.run(
+        load(args.n, args.seconds, args.qc, args.clients, args.outstanding)
+    )
+    prof.disable()
+    wall = time.perf_counter() - t0
+
+    stats = pstats.Stats(prof)
+    buckets: dict = {}
+    total_tt = 0.0
+    for (file, line, func), (cc, nc, tt, ct, callers) in stats.stats.items():
+        key = f"{file}:{func}"
+        buckets[bucket_of(key)] = buckets.get(bucket_of(key), 0.0) + tt
+        total_tt += tt
+
+    print(f"\n=== n={args.n} qc={args.qc}: {done} committed in {wall:.1f}s "
+          f"(instrumented {done / wall:.1f} req/s)")
+    print(f"profiled CPU: {total_tt:.1f}s over {wall:.1f}s wall "
+          f"({total_tt / wall * 100:.0f}% — cProfile overhead excluded)")
+    rec = {
+        "metric": "cpu_ms_per_committed_request",
+        "n": args.n,
+        "qc_mode": args.qc,
+        "committed": done,
+        "wall_s": round(wall, 1),
+        "req_s_instrumented": round(done / wall, 1),
+        "budget_ms_per_req": {},
+    }
+    print(f"\n{'bucket':<18}{'CPU s':>9}{'%':>7}{'ms/req':>9}")
+    for name, tt in sorted(buckets.items(), key=lambda kv: -kv[1]):
+        ms = tt / max(1, done) * 1e3
+        rec["budget_ms_per_req"][name] = round(ms, 2)
+        print(f"{name:<18}{tt:>9.2f}{tt / total_tt * 100:>6.1f}%{ms:>9.2f}")
+
+    print(f"\ntop {args.top} functions by self time:")
+    s = io.StringIO()
+    pstats.Stats(prof, stream=s).sort_stats("tottime").print_stats(args.top)
+    for ln in s.getvalue().splitlines():
+        if ln.strip() and ("{" in ln or ".py" in ln or "ncalls" in ln):
+            print("  " + ln.strip()[:150])
+    print()
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
