@@ -83,7 +83,13 @@ async def _pump(client, stop_at: float, latencies: List[float], errors: List[int
     pump vanishes from the latency distribution, silently flattering
     p99 exactly when the system was slowest."""
     i = 0
-    retries = max(3, int(40.0 / max(client.request_timeout, 0.1)))
+    # Patience must exceed the worst-case failover-plus-congestion
+    # recovery or the sample is censored exactly when the system is
+    # slowest: measured at n=64/QC on this one-core host, a view change
+    # under chaos can take ~45 s to drain its queue backlog, and a
+    # request committed at t+45 whose replies are still in flight is a
+    # tail latency sample, not a timeout.
+    retries = max(3, int(75.0 / max(client.request_timeout, 0.1)))
     while time.perf_counter() < stop_at:
         t0 = time.perf_counter()
         try:

@@ -182,6 +182,9 @@ class Replica:
         self._mac = mac_mod.MacBank(seed, cfg.kx_pubkeys)
         # SlotFetch rate limiting: sender -> monotonic time last served
         self._slot_fetch_served: Dict[str, float] = {}
+        # (sender, new_view, sig) -> validated VC (resend dedup at the
+        # target primary; see _batch_items)
+        self._vc_validation_cache: Dict[tuple, tuple] = {}
         self._probe_rr = 0  # slot-probe target rotation
         # the NEW-VIEW that installed our current view (view-sync serving)
         self.last_new_view: Optional[NewView] = None
@@ -542,16 +545,36 @@ class Replica:
             # every backup measured ~40% of a 64-replica storm round's
             # CPU (n^2 certificate walks on one host).
             if self.cfg.primary(msg.new_view) == self.id:
-                res = validate_view_change(
-                    self.cfg, msg, current_view_floor=0
-                )
+                # Retransmissions are byte-identical (senders re-send the
+                # same certificate on timer expiry): memoize by the
+                # envelope signature so a storm of resends costs one
+                # structural walk, not one per wave (the walk at the
+                # target primary was a measurable slice of the n=64
+                # congestion-collapse wedge).
+                ck = (msg.sender, msg.new_view, msg.sig)
+                res = self._vc_validation_cache.get(ck)
                 if res is None:
+                    res = validate_view_change(
+                        self.cfg, msg, current_view_floor=0
+                    )
+                    if res is not None:
+                        if len(self._vc_validation_cache) >= 128:
+                            self._vc_validation_cache.pop(
+                                next(iter(self._vc_validation_cache))
+                            )
+                        self._vc_validation_cache[ck] = res
+                if res is None:
+                    # distinct from dropped_precheck: a failover CANNOT
+                    # complete while the target primary rejects VCs, so
+                    # this must be visible in a wedge post-mortem
+                    self.metrics["bad_viewchange_precheck"] += 1
                     return []
                 msg._validated = res  # skip re-validation in on_view_change
                 items.extend(res[2])
         elif isinstance(msg, NewView):
             res = validate_new_view(self.cfg, msg)
             if res is None:
+                self.metrics["bad_newview_precheck"] += 1
                 return []
             msg._validated = res
             items.extend(res[1])

@@ -33,6 +33,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..crypto.verifier import BatchItem
@@ -339,9 +341,27 @@ class ViewChanger:
         self.new_view_sent: set = set()
         self._timer: Optional[asyncio.TimerHandle] = None
         self._probe_timer: Optional[asyncio.TimerHandle] = None
-        self._probe_task: Optional[asyncio.Task] = None
-        self._vc_task: Optional[asyncio.Task] = None
+        # Strong refs to EVERY in-flight fire-and-forget task. A single
+        # overwritable slot loses the reference to a still-suspended
+        # predecessor (e.g. a start_view_change parked on the checkpoint
+        # QC pairing under load when the next expiry fires) — the
+        # collector may then destroy the pending task, leaving the
+        # replica frozen (in_view_change set) with its VIEW-CHANGE never
+        # broadcast and no exception anywhere. Measured as the n=64
+        # chaos wedge: 40 replicas "at target 2", 5 VCs in the new
+        # primary's store.
+        self._bg_tasks: set = set()
         self._timeout = replica.cfg.view_timeout
+        # Deterministic per-replica jitter for every failover timer: a
+        # committee-wide stall (e.g. a checkpoint pause) otherwise expires
+        # every replica's timer in the same instant, and the synchronized
+        # VIEW-CHANGE waves + resends congest the pipeline faster than
+        # any target's certificate can complete (the measured n=64
+        # congestion-collapse wedge). +-20% decorrelates the waves.
+        # content-stable seed: str hash() is salted per process, which
+        # would make jitter (and so failover trajectories) irreproducible
+        # from a bench seed
+        self._rng = random.Random(zlib.crc32(replica.id.encode()))
         self._nv_granted: set = set()  # views granted a NEW-VIEW window
         # failover deferral (see _expired): progress markers at arm time
         # and the backlog head at the last deferral
@@ -349,12 +369,16 @@ class ViewChanger:
         self._armed_committed = -1
         self._deferred_key = None
         self._target_expiries = 0  # expiries while frozen at one target
+        self._last_target_support = -1  # store size at the last expiry
         # highest view seen in signature-verified traffic (bounded by
         # MAX_VIEWS_AHEAD) — evidence a NEW-VIEW we never received exists
         self._view_hint = 0
         self._hint_fetches = 0
 
     # -- timers ---------------------------------------------------------
+
+    def _jitter(self, t: float) -> float:
+        return t * self._rng.uniform(0.8, 1.2)
 
     def arm(self) -> None:
         """Arm the failover timer if not already armed (called whenever a
@@ -366,10 +390,10 @@ class ViewChanger:
             loop = asyncio.get_running_loop()
             self._armed_exec = self.r.executed_seq
             self._armed_committed = self.r.max_committed_seen
-            self._timer = loop.call_later(self._timeout, self._expired)
+            self._timer = loop.call_later(self._jitter(self._timeout), self._expired)
             if self._probe_timer is None:
                 self._probe_timer = loop.call_later(
-                    self._timeout / 2, self._probe
+                    self._jitter(self._timeout / 2), self._probe
                 )
 
     def reset(self) -> None:
@@ -402,8 +426,24 @@ class ViewChanger:
         and arming failover on local holes causes join cascades)."""
         if self._probe_timer is None and self.r.cfg.view_timeout > 0:
             self._probe_timer = asyncio.get_running_loop().call_later(
-                max(0.25, self._timeout / 4), self._probe
+                self._jitter(max(0.25, self._timeout / 4)), self._probe
             )
+
+    def _spawn(self, coro) -> None:
+        """Launch a fire-and-forget coroutine with a retained reference
+        and consumed exception (see _bg_tasks above)."""
+        task = asyncio.ensure_future(coro)
+        self._bg_tasks.add(task)
+
+        def _done(t: asyncio.Task) -> None:
+            self._bg_tasks.discard(t)
+            if not t.cancelled() and t.exception() is not None:
+                log.error(
+                    "%s: background view-change task failed",
+                    self.r.id, exc_info=t.exception(),
+                )
+
+        task.add_done_callback(_done)
 
     def _probe(self) -> None:
         self._probe_timer = None
@@ -421,14 +461,11 @@ class ViewChanger:
         ):
             return
         # retain the task (a bare ensure_future can be collected mid-send)
-        self._probe_task = asyncio.ensure_future(self.r.send_slot_probe())
-        self._probe_task.add_done_callback(
-            lambda _t: setattr(self, "_probe_task", None)
-        )
+        self._spawn(self.r.send_slot_probe())
         # keep probing while the stall lasts (the response itself can be
         # dropped); the server side rate-limits per sender
         self._probe_timer = asyncio.get_running_loop().call_later(
-            max(0.5, self._timeout / 2), self._probe
+            self._jitter(max(0.5, self._timeout / 2)), self._probe
         )
 
     def _expired(self) -> None:
@@ -472,35 +509,40 @@ class ViewChanger:
         self._deferred_key = None
         if self.in_view_change:
             self._target_expiries += 1
-            if self._target_expiries % 2 == 1:
-                # First expiry at this target: RETRANSMIT the VIEW-CHANGE
-                # for the SAME view instead of escalating — the broadcast
-                # itself is lossy, and unilateral +1 laddering outruns the
-                # view the committee actually installs, so the eventual
-                # NEW-VIEW gets rejected below-target and the replica is
-                # marooned frozen (measured at n=64/2% drop: 486
-                # below-target rejections, share quorum eroded to a
-                # committee-wide stall). Escalate only every second
-                # expiry, with the usual timeout doubling in between.
+            # "gathering": the target's certificate is visibly STILL
+            # FILLING (>= f+1 support and more than at the last expiry).
+            # A full-but-static store means the target's primary is dead
+            # or hopeless — escalation is then correct (a plain >= f+1
+            # check deadlocked the two-dead-primaries cascade: everyone
+            # saw support for view 1 forever and nobody walked to 2).
+            support = len(self.vc_store.get(self.target_view, {}))
+            gathering = (
+                support >= r.cfg.weak_quorum
+                and support > self._last_target_support
+            )
+            self._last_target_support = support
+            if self._target_expiries % 2 == 1 or gathering:
+                # RETRANSMIT for the SAME view instead of escalating:
+                # (a) on the first expiry at a target — the broadcast
+                # itself is lossy, and unilateral +1 laddering outruns
+                # the view the committee actually installs (measured:
+                # 486 below-target rejections marooned frozen replicas);
+                # (b) whenever we can SEE >= f+1 VIEW-CHANGEs for our
+                # target — the committee is gathering; escalating away
+                # then guarantees no view ever accumulates 2f+1 at its
+                # primary (measured congestion-collapse wedge at n=64:
+                # targets 2/3/4 split 49/8/7, every store under quorum).
                 r.metrics["view_change_resent"] += 1
                 self._timeout = min(self._timeout * 2, 60.0)
                 self._timer = asyncio.get_running_loop().call_later(
-                    self._timeout, self._expired
+                    self._jitter(self._timeout), self._expired
                 )
-                self._vc_task = asyncio.ensure_future(
-                    self.resend_view_change()
-                )
-                self._vc_task.add_done_callback(
-                    lambda _t: setattr(self, "_vc_task", None)
-                )
+                self._spawn(self.resend_view_change())
                 return
         self._target_expiries = 0
         # retain the task: a bare ensure_future is only weakly referenced
         # by the loop and can be collected mid-broadcast
-        self._vc_task = asyncio.ensure_future(
-            self.start_view_change(max(self.target_view, r.view) + 1)
-        )
-        self._vc_task.add_done_callback(lambda _t: setattr(self, "_vc_task", None))
+        self._spawn(self.start_view_change(max(self.target_view, r.view) + 1))
 
     def _backlog_head(self):
         """Oldest outstanding client work, as a stable identity: relay
@@ -560,18 +602,19 @@ class ViewChanger:
         self.in_view_change = True
         self.target_view = new_view
         self._target_expiries = 0
+        self._last_target_support = -1
         self.r.metrics["view_changes_started"] += 1
         # exponential backoff: if this view change stalls, suspect further
         self._timeout = min(self._timeout * 2, 60.0)
         if self.r.cfg.view_timeout > 0:
             loop = asyncio.get_running_loop()
             self.cancel()
-            self._timer = loop.call_later(self._timeout, self._expired)
+            self._timer = loop.call_later(self._jitter(self._timeout), self._expired)
             # the recovery probe keeps running while frozen (see _probe:
             # catch-up in the current view is a frozen replica's only way
             # back when the committee never joins its view change)
             self._probe_timer = loop.call_later(
-                max(0.5, self._timeout / 4), self._probe
+                self._jitter(max(0.5, self._timeout / 4)), self._probe
             )
 
         await self.r.ensure_checkpoint_qc()  # QC mode: one aggregate for h
@@ -683,7 +726,9 @@ class ViewChanger:
     async def on_view_change(self, msg: ViewChange) -> None:
         """Signature-verified VIEW-CHANGE arrives (own or peer's)."""
         r = self.r
+        r.metrics["vc_msgs_seen"] += 1
         if msg.new_view <= r.view:
+            r.metrics["vc_msgs_stale"] += 1
             return
         if msg.new_view > r.view + self.MAX_VIEWS_AHEAD:
             r.metrics["viewchange_too_far"] += 1
@@ -860,7 +905,15 @@ class ViewChanger:
         self.in_view_change = False
         self.target_view = new_view
         self._target_expiries = 0
+        self._last_target_support = -1
         self.vc_store = {v: s for v, s in self.vc_store.items() if v > new_view}
+        # same for the resend-validation memo: entries for installed
+        # views pin fully-parsed certificates (whole request blocks in
+        # non-QC mode) and would otherwise live until 128 future inserts
+        # that a replica who is primary only every n-th view may never see
+        r._vc_validation_cache = {
+            k: v for k, v in r._vc_validation_cache.items() if k[1] > new_view
+        }
         # NOTE: the backoff timeout is deliberately NOT reset here — only
         # actual request progress resets it (reset() via _execute_ready).
         # Resetting on install lets a slow-but-correct view (e.g. QC

@@ -67,8 +67,9 @@ def test_partitioned_replica_catches_up_without_view_change():
         # probe chain); a totally quiet committee has nothing to repair
         # toward until the next checkpoint broadcast
         await _pump_n(c, 2, "post")
-        # probes fire at view_timeout/2; give a few rounds
-        deadline = asyncio.get_event_loop().time() + 20.0
+        # probes fire at view_timeout/2 (jittered); give generous rounds —
+        # under batch-run CPU contention a round trip can take seconds
+        deadline = asyncio.get_event_loop().time() + 45.0
         target = max(r.executed_seq for r in com.replicas)
         while (
             victim.executed_seq < target
