@@ -185,6 +185,8 @@ class Replica:
         # (sender, new_view, sig) -> validated VC (resend dedup at the
         # target primary; see _batch_items)
         self._vc_validation_cache: Dict[tuple, tuple] = {}
+        # verified block digest -> validated Request list (_validate_block)
+        self._decoded_blocks: Dict[str, List[Request]] = {}
         self._probe_rr = 0  # slot-probe target rotation
         # the NEW-VIEW that installed our current view (view-sync serving)
         self.last_new_view: Optional[NewView] = None
@@ -524,7 +526,7 @@ class Replica:
         items = [BatchItem(pubkey=pub, msg=msg.signing_payload(), sig=sig)]
         if isinstance(msg, PrePrepare):
             # a proposal also carries client signatures for every request
-            reqs = self._validate_block(msg.block)
+            reqs = self._validate_block(msg.block, msg.digest)
             if reqs is None:
                 return []
             for req in reqs:
@@ -580,11 +582,25 @@ class Replica:
             items.extend(res[1])
         return items
 
-    def _validate_block(self, block) -> Optional[List[Request]]:
+    MAX_DECODED_BLOCKS = 2048  # digest -> validated Request list cache
+
+    def _validate_block(self, block, digest: str = None) -> Optional[List[Request]]:
         """Structural admission for a proposed block: every entry decodes to
         a Request whose sender is the client it claims to be and whose
         signature field is well-formed. Runs regardless of signature mode so
-        a hostile block can never reach execution type-confused."""
+        a hostile block can never reach execution type-confused.
+
+        A block is validated up to three times per replica (signature-item
+        collection, phase admission, ordered execution), so callers pass
+        the digest for a cache LOOKUP. Insertion happens ONLY at sites
+        where digest <-> block binding has been verified (_remember_block
+        — instance admission checks block_digest): caching on a claimed,
+        unverified digest would let a hostile pre-prepare poison the
+        entry an honest block later matches."""
+        if digest is not None:
+            hit = self._decoded_blocks.get(digest)
+            if hit is not None:
+                return hit
         reqs: List[Request] = []
         for rd in block:
             try:
@@ -602,6 +618,12 @@ class Replica:
                 return None
             reqs.append(req)
         return reqs
+
+    def _remember_block(self, digest: str, reqs: List[Request]) -> None:
+        """Cache a validated block decode under a VERIFIED digest."""
+        if len(self._decoded_blocks) >= self.MAX_DECODED_BLOCKS:
+            self._decoded_blocks.pop(next(iter(self._decoded_blocks)))
+        self._decoded_blocks[digest] = reqs
 
     # ------------------------------------------------------------------
     # routing
@@ -776,7 +798,8 @@ class Replica:
         inst = self._instance(msg.view, msg.seq)
         if isinstance(msg, PrePrepare):
             # structural block admission runs even with signatures off
-            if self._validate_block(msg.block) is None:
+            reqs = self._validate_block(msg.block, msg.digest)
+            if reqs is None:
                 self.metrics["bad_block"] += 1
                 return
             actions = inst.on_pre_prepare(msg)
@@ -784,8 +807,10 @@ class Replica:
                 inst.t_started = time.perf_counter()  # commit-latency clock
             if inst.pre_prepare is msg:
                 # admitted (digest verified by the instance): remember the
-                # block so digest-only certificates can be refilled later
+                # block so digest-only certificates can be refilled later,
+                # and its decode so execution skips the third validation
                 self.store_block(msg.seq, msg.digest, msg.block)
+                self._remember_block(msg.digest, reqs)
         elif isinstance(msg, Prepare):
             actions = inst.on_prepare(msg)
         else:
@@ -983,7 +1008,7 @@ class Replica:
                 self.stats.commit_ms.record(
                     (time.perf_counter() - src.t_started) * 1e3
                 )
-            reqs = self._validate_block(act.block)
+            reqs = self._validate_block(act.block, act.digest)
             if reqs is None:  # unreachable: admission validated on entry
                 self.metrics["exec_bad_block"] += 1
                 continue
@@ -1369,14 +1394,21 @@ class Replica:
                         and not inst.executed
                     ):
                         qc_stalled[inst.digest].append(inst)
-            for inst in qc_stalled.get(dg, ()):
-                if self._validate_block(block) is None:
+            stalled = qc_stalled.get(dg, ())
+            if stalled:
+                # one decode for all stalled instances sharing the digest,
+                # remembered (dg was verified against the block above) so
+                # the execution path's validation hits the cache too
+                reqs = self._validate_block(block, dg)
+                if reqs is None:
                     self.metrics["bad_block_reply"] += 1
-                    break
-                self.metrics["holes_repaired"] += 1
-                for act in inst.adopt_block(block):
-                    if isinstance(act, ExecuteBlock):
-                        await self._perform(act)
+                else:
+                    self._remember_block(dg, reqs)
+                    for inst in stalled:
+                        self.metrics["holes_repaired"] += 1
+                        for act in inst.adopt_block(block):
+                            if isinstance(act, ExecuteBlock):
+                                await self._perform(act)
             waiters = self.block_pending.pop(dg, None)
             if not waiters:
                 continue

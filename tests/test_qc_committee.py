@@ -100,8 +100,8 @@ def test_qc_failover_preserves_state():
         try:
             assert await com.clients[0].submit("put a 1") == "ok"
             com.replica("r0").kill()
-            assert await com.clients[0].submit("put b 2", retries=20) == "ok"
-            assert await com.clients[0].submit("get a", retries=20) == "1"
+            assert await com.clients[0].submit("put b 2", retries=60) == "ok"
+            assert await com.clients[0].submit("get a", retries=60) == "1"
             views = {x.id: x.view for x in com.replicas if x._running}
             assert all(v >= 1 for v in views.values()), views
         finally:
@@ -169,7 +169,7 @@ def test_qc_checkpoint_aggregate_in_viewchange():
                 lambda: all(r.stable_seq > 0 for r in com.replicas)
             )
             com.replica("r0").kill()
-            assert await com.clients[0].submit("put after 1", retries=20) == "ok"
+            assert await com.clients[0].submit("put after 1", retries=60) == "ok"
             survivors = [r for r in com.replicas if r.id != "r0"]
             assert all(r.view >= 1 for r in survivors)
             assert await _eventually(

@@ -59,7 +59,7 @@ def test_failover_after_primary_crash():
 
         # kill the view-0 primary
         c.replica("r0").kill()
-        result = await client.submit("put b 2", retries=20)
+        result = await client.submit("put b 2", retries=60)
         assert result == "ok"
         survivors = [r for r in c.replicas if r.id != "r0"]
         assert all(r.view >= 1 for r in survivors)
@@ -69,7 +69,7 @@ def test_failover_after_primary_crash():
             )
         )
         # the committee keeps working in the new view
-        assert await client.submit("get a", retries=20) == "1"
+        assert await client.submit("get a", retries=60) == "1"
         await c.stop()
 
     _run(main())
@@ -88,7 +88,7 @@ def test_failover_after_stable_checkpoint():
             assert await client.submit(f"put k{i} {i}") == "ok"
         assert all(r.stable_seq > 0 for r in c.replicas)
         c.replica("r0").kill()
-        assert await client.submit("put after 1", retries=20) == "ok"
+        assert await client.submit("put after 1", retries=60) == "ok"
         survivors = [r for r in c.replicas if r.id != "r0"]
         assert all(r.view >= 1 for r in survivors)
         assert all(r.app.data.get("after") == "1" for r in survivors)
