@@ -30,8 +30,31 @@ from typing import Any, ClassVar, Dict, List, Optional, Tuple, Type
 # ---------------------------------------------------------------------------
 
 
+_native_encode = None
+_native_checked = False
+
+
 def canonical_json(obj: Any) -> bytes:
-    """Deterministic JSON bytes: sorted keys, no whitespace, UTF-8."""
+    """Deterministic JSON bytes: sorted keys, no whitespace, ensure-ascii.
+
+    This is both the wire format and the digest/signing preimage, so the
+    native encoder (native/canonjson.cpp) must be byte-identical to the
+    json module — it self-tests at load, covers exactly the wire subset,
+    and returns None (-> json fallback) for anything else. Lazy-bound so
+    importing messages never forces a native build."""
+    global _native_encode, _native_checked
+    if not _native_checked:
+        _native_checked = True
+        try:
+            from .native import canonjson_encode
+
+            _native_encode = canonjson_encode
+        except Exception:  # noqa: BLE001 — any native issue: pure json
+            _native_encode = None
+    if _native_encode is not None:
+        out = _native_encode(obj)
+        if out is not None:
+            return out
     return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
 
 

@@ -76,13 +76,7 @@ def _load_library(src: str, so: str, configure, extra=()) -> Optional[ctypes.CDL
     AttributeError for stale exports, return None to reject). Any
     failure degrades to the caller's Python fallback."""
     name = os.path.basename(so)
-    try:
-        fresh = os.path.exists(so) and (
-            os.path.getmtime(so) >= os.path.getmtime(src)
-        )
-    except OSError:  # source missing: use the existing .so as-is
-        fresh = os.path.exists(so)
-    if not fresh and not _build_so(src, so, extra=extra):
+    if not _ensure_built(src, so, extra=extra):
         return None
     try:
         lib = ctypes.CDLL(so)
@@ -379,3 +373,98 @@ def sha512_batch(msgs: Sequence[bytes]) -> np.ndarray:
     for i, m in enumerate(msgs):
         out[i] = np.frombuffer(hashlib.sha512(m).digest(), np.uint8)
     return out
+
+
+# ---------------------------------------------------------------------------
+# canonical-JSON encoder (CPython extension module, canonjson.cpp)
+# ---------------------------------------------------------------------------
+
+_SRC_CANON = os.path.join(os.path.dirname(__file__), "canonjson.cpp")
+_SO_CANON = os.path.join(os.path.dirname(__file__), "_canonjson.so")
+_canon_lock = threading.Lock()
+_canon_mod = None
+_canon_tried = False
+
+
+def _python_includes():
+    import sysconfig
+
+    return [f"-I{sysconfig.get_path('include')}"]
+
+
+def _ensure_built(src: str, so: str, extra=()) -> bool:
+    """Shared freshness check + build-on-demand (used by the ctypes
+    loader below and the extension loader): rebuild when the source is
+    newer, tolerate a missing source by trusting the cached .so."""
+    try:
+        fresh = os.path.exists(so) and (
+            os.path.getmtime(so) >= os.path.getmtime(src)
+        )
+    except OSError:  # source missing: use the existing .so as-is
+        fresh = os.path.exists(so)
+    return fresh or _build_so(src, so, extra=extra)
+
+
+def _load_canonjson():
+    """Build (on demand) and import the _canonjson extension; None on any
+    failure — callers keep the pure-json path. Unlike the ctypes
+    libraries this is a real CPython extension (it walks Python objects),
+    so it is imported via ExtensionFileLoader, not CDLL."""
+    global _canon_mod, _canon_tried
+    if _canon_tried:  # lock-free fast path: _canon_mod is write-once
+        return _canon_mod
+    with _canon_lock:
+        if _canon_tried:
+            return _canon_mod
+        _canon_tried = True  # every exit below is final (no per-call retry)
+        if not _ensure_built(_SRC_CANON, _SO_CANON, extra=_python_includes()):
+            return None
+        try:
+            import importlib.machinery
+            import importlib.util
+
+            loader = importlib.machinery.ExtensionFileLoader(
+                "_canonjson", _SO_CANON
+            )
+            spec = importlib.util.spec_from_loader("_canonjson", loader)
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+        except (ImportError, OSError) as e:
+            log.warning("canonjson load failed: %s — json fallback", e)
+            return None
+        # self-test: byte-exact equivalence on a representative sample; a
+        # silently divergent encoder would FORK the committee (digests),
+        # so any mismatch rejects the library outright
+        import json as _json
+
+        samples = [
+            {"kind": "commit", "seq": 1, "view": 0, "digest": "ab" * 32,
+             "sig": "", "b": [1, 2, [3]], "n": None, "t": True},
+            {"z": "\x00\x1f\"\\\né€\U0001f600", "a": -(2**80)},
+            {"": {"nested": ["\ud800", 2**63 - 1, -(2**63)]}},
+        ]
+        for s in samples:
+            want = _json.dumps(s, sort_keys=True, separators=(",", ":")).encode(
+                "utf-8", "surrogatepass"
+            )
+            if mod.encode(s) != want:
+                log.warning("canonjson self-test mismatch — json fallback")
+                return None
+        _canon_mod = mod
+        return mod
+
+
+def canonjson_encode(obj):
+    """Native canonical encode, or None when the library is unavailable
+    or the object leaves the wire subset (caller falls back to json)."""
+    mod = _load_canonjson()
+    if mod is None:
+        return None
+    try:
+        return mod.encode(obj)
+    except (TypeError, RecursionError):
+        return None
+
+
+def canonjson_available() -> bool:
+    return _load_canonjson() is not None
