@@ -187,6 +187,8 @@ class Replica:
         self._vc_validation_cache: Dict[tuple, tuple] = {}
         # verified block digest -> validated Request list (_validate_block)
         self._decoded_blocks: Dict[str, List[Request]] = {}
+        # (client, ts) -> monotonic time of last cached-reply resend
+        self._reply_resent: Dict[Tuple[str, int], float] = {}
         self._probe_rr = 0  # slot-probe target rotation
         # the NEW-VIEW that installed our current view (view-sync serving)
         self.last_new_view: Optional[NewView] = None
@@ -688,6 +690,21 @@ class Replica:
             # duplicate: re-send the cached reply if we already executed it
             cached = recent.get(req.timestamp)
             if cached is not None:
+                # Cooldown per (client, ts): a retry BROADCAST otherwise
+                # makes every replica answer at once — 61 replies per
+                # retry wave per request where the client needs f+1.
+                # Measured in 3-crash storms: the reply flood from 128
+                # retrying clients kept failover queues thousands deep
+                # exactly while the new view was forming. First answer is
+                # always immediate; repeats within the window are dropped
+                # (the client's next 4.5 s retry beats a 1 s cooldown).
+                now = time.monotonic()
+                if now - self._reply_resent.get(key, 0.0) < 1.0:
+                    self.metrics["reply_resend_squelched"] += 1
+                    return
+                if len(self._reply_resent) >= 8192:
+                    self._reply_resent.pop(next(iter(self._reply_resent)))
+                self._reply_resent[key] = now
                 if not cached.sig and not cached.mac:
                     # cached by a non-designated replier: authenticate now
                     self._auth_reply(cached)
