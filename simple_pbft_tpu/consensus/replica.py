@@ -702,6 +702,10 @@ class Replica:
                 if now - self._reply_resent.get(key, 0.0) < 1.0:
                     self.metrics["reply_resend_squelched"] += 1
                     return
+                # delete-then-reinsert keeps the dict insertion-ordered by
+                # RECENCY, so cap eviction drops the coldest key, not a
+                # hot one refreshed milliseconds ago
+                self._reply_resent.pop(key, None)
                 if len(self._reply_resent) >= 8192:
                     self._reply_resent.pop(next(iter(self._reply_resent)))
                 self._reply_resent[key] = now

@@ -1,0 +1,60 @@
+"""Cached-reply resend cooldown (round-4 reply-flood fix).
+
+A retrying client's broadcast made every replica resend its cached reply
+at once; duplicates inside a 1 s window are now squelched per
+(client, ts). These tests pin: first resend immediate, in-window
+duplicates dropped (metric counted), post-window retry answered again.
+"""
+
+import asyncio
+
+from simple_pbft_tpu.committee import LocalCommittee
+from simple_pbft_tpu.messages import Message, Reply, Request
+
+
+class CapturingTransport:
+    def __init__(self, node_id):
+        self.node_id = node_id
+        self.sent = []
+
+    async def send(self, dest, raw):
+        self.sent.append((dest, raw))
+
+    async def broadcast(self, raw, dests):
+        pass
+
+
+def run(coro, timeout=30):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def test_cached_reply_resend_cooldown():
+    async def scenario():
+        com = LocalCommittee.build(n=4)
+        rep = com.replica("r1")
+        cap = CapturingTransport("r1")
+        rep.transport = cap
+        client = com.clients[0]
+        # simulate an executed request: cached reply present
+        cached = Reply(view=0, seq=3, client_id="c0", timestamp=7, result="ok")
+        rep.recent_replies["c0"] = {7: cached}
+        req = Request(client_id="c0", timestamp=7, operation="put k v")
+        client.signer.sign_msg(req)
+
+        await rep._on_request(req)  # first retry: answered immediately
+        assert len(cap.sent) == 1
+        msg = Message.from_wire(cap.sent[0][1])
+        assert isinstance(msg, Reply) and msg.result == "ok"
+
+        await rep._on_request(req)  # duplicate inside the window: squelched
+        await rep._on_request(req)
+        assert len(cap.sent) == 1
+        assert rep.metrics["reply_resend_squelched"] == 2
+
+        rep._reply_resent[("c0", 7)] -= 2.0  # age the window out
+        await rep._on_request(req)  # next retry wave: answered again
+        assert len(cap.sent) == 2
+
+        await com.stop()
+
+    run(scenario())
