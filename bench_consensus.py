@@ -338,6 +338,9 @@ async def main() -> None:
         # carries 2f+1 O(1) QCs instead of 2f+1 full vote certificates
         "qc16": dict(name="bls-qc-n16", n=16, qc_mode=True),
         "qc64": dict(name="bls-qc-n64", n=64, qc_mode=True),
+        # the 10k req/s extrapolation's shape (cpu_budget_r04.md): O(n)
+        # vote traffic at the reference-class committee size
+        "qc100": dict(name="bls-qc-n100", n=100, qc_mode=True),
     }
     chaos = None
     if args.chaos:
