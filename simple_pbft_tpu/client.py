@@ -212,7 +212,14 @@ class Client:
         applied by this call — see the exception's docstring before
         resubmitting non-idempotent operations)."""
         ts = next(self._ts)
-        req = Request(client_id=self.id, timestamp=ts, operation=operation)
+        # completion floor: everything below the oldest still-outstanding
+        # submit is answered and will never be retransmitted (see
+        # messages.Request.ack — this is what lets replicas fold replay
+        # state without NACKing a pipelined sibling still in flight)
+        floor = min(self._waiters, default=ts) - 1
+        req = Request(
+            client_id=self.id, timestamp=ts, operation=operation, ack=floor
+        )
         self.signer.sign_msg(req)
         raw = req.to_wire()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()

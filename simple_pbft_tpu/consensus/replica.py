@@ -71,6 +71,24 @@ from .viewchange import (
 
 log = logging.getLogger("pbft.replica")
 
+# Per-client count of ABOVE-FLOOR reply-cache entries beyond which the
+# checkpoint fold stops honoring the client's declared completion floor
+# (Request.ack) and reverts to the horizon-only fold: replay-state
+# memory must be bounded even for a client that never declares (or
+# deliberately under-declares) its floor. An honest pipelined client
+# keeps at most its in-flight window above the floor, far under this.
+RECENT_REPLIES_CAP = 512
+
+# Above-floor entries still fold once their executing seq is this many
+# checkpoint intervals old: a DEPARTED client's final in-flight window
+# never gets a higher floor, and without an age-out those entries would
+# ride every future snapshot forever. 16 intervals is a 16x longer
+# runway than the horizon rule — and in the fold-race scenario the floor
+# protects against (a stalled retry under load), seqs advance slowly
+# exactly when the race window matters, so an ACTIVE client's in-flight
+# request effectively never ages out.
+STALE_FOLD_INTERVALS = 16
+
 
 class Replica:
     """One PBFT replica: consensus state, execution, crypto seam."""
@@ -110,6 +128,11 @@ class Replica:
         # exact executed timestamps (with their replies) above it.
         self.client_watermark: Dict[str, int] = {}
         self.recent_replies: Dict[str, Dict[int, Reply]] = {}
+        # highest signed completion floor seen per client, updated only
+        # from EXECUTED blocks (so it is a deterministic function of the
+        # agreed history and part of checkpoint state). The fold in
+        # _emit_checkpoint never crosses it — see messages.Request.ack.
+        self.client_ack: Dict[str, int] = {}
         # seq -> digest for executed blocks above the stable watermark
         # (safety audits, slot-fetch block refill); insertion-ordered by
         # execution. The reference's append-only CommittedMsgs
@@ -1035,6 +1058,8 @@ class Replica:
                 continue
             for req in reqs:
                 self.relay_buffer.pop((req.client_id, req.timestamp), None)
+                if req.ack > self.client_ack.get(req.client_id, 0):
+                    self.client_ack[req.client_id] = req.ack
                 recent = self.recent_replies.get(req.client_id, {})
                 if req.timestamp in recent:
                     # EXACT-ts replay that slipped into a block: no-op.
@@ -1128,6 +1153,10 @@ class Replica:
             {
                 "app": self.app.snapshot(),
                 "watermark": self.client_watermark,
+                # declared completion floors gate the fold, so a
+                # state-transferred replica must restore them or its
+                # future folds (hence checkpoint digests) would diverge
+                "ack": self.client_ack,
                 # replies canonicalized: sender/sig blanked (each replica
                 # re-signs on resend) AND view blanked — replicas execute
                 # the same request in DIFFERENT views around a failover,
@@ -1158,16 +1187,47 @@ class Replica:
         # executed at least one FULL checkpoint interval ago (reply.seq
         # records the executing seq, so the fold is a deterministic
         # function of executed history and every replica folds
-        # identically). Folding everything to max(ts) would reintroduce
-        # the pipelined-client deadlock at checkpoint granularity: a
-        # lower-ts request still in flight when the fold lands would be
-        # skipped forever once it commits. The one-interval lag keeps
-        # every timestamp answerable/deduplicable for >= interval seqs
-        # after execution — far longer than any client retry window.
-        # The latest folded reply stays cached for replay answers.
+        # identically) AND at/below the client's signed completion floor
+        # (Request.ack, also taken from executed blocks only). The seq
+        # horizon alone is NOT a time guarantee: at high block rates one
+        # interval passes in milliseconds, so a pipelined client's
+        # dropped-then-retried lower timestamp could fall under the fold
+        # mid-flight and bounce as SUPERSEDED (found by the fading-load
+        # drain-tail test). The floor closes that: a client's in-flight
+        # timestamps are by definition above its declared floor. Clients
+        # that never declare (ack=0) keep today's horizon-only fold once
+        # their cache is oversized — the memory bound must not depend on
+        # client cooperation. The latest folded reply stays cached for
+        # replay answers.
         horizon = seq - self.cfg.checkpoint_interval
         for c, recent in self.recent_replies.items():
-            folded = [ts for ts, r in recent.items() if r.seq <= horizon]
+            floor = self.client_ack.get(c, 0)
+            # the cap counts only ABOVE-floor entries: below-floor ones
+            # fold within one interval by the horizon rule regardless, so
+            # they can't accumulate — and counting them would trip the
+            # fallback for a perfectly-declaring high-throughput client
+            # (whose last-interval executions alone can exceed the cap),
+            # reintroducing the exact fold race the floor exists to stop
+            if sum(1 for ts in recent if ts > floor) > RECENT_REPLIES_CAP:
+                folded = [ts for ts, r in recent.items() if r.seq <= horizon]
+            else:
+                # Above-floor entries fold only when the client's ENTIRE
+                # window is stale — the departed-client signature (its
+                # last in-flight batch has no later request to raise the
+                # floor, and must not ride every future snapshot
+                # forever). Any fresh execution keeps the whole window
+                # alive, so an ACTIVE pipelined client's siblings are
+                # never aged out under third-party load. Residual,
+                # documented trade: a client whose ONLY outstanding
+                # request stays unexecuted for STALE_FOLD_INTERVALS
+                # intervals (indistinguishable from departed) gets an
+                # explicit SUPERSEDED when it finally lands.
+                stale = seq - STALE_FOLD_INTERVALS * self.cfg.checkpoint_interval
+                all_stale = all(r.seq <= stale for r in recent.values())
+                folded = [
+                    ts for ts, r in recent.items()
+                    if r.seq <= horizon and (ts <= floor or all_stale)
+                ]
             if not folded:
                 continue
             top = max(folded)
@@ -1177,6 +1237,17 @@ class Replica:
             for ts in folded:
                 if ts != top:
                     del recent[ts]
+        # A floor at/below the watermark gates nothing (the fold's floor
+        # rule only spares entries ABOVE it): drop such entries so a
+        # departed client leaves only its watermark behind — a returning
+        # client re-declares with its first executed request. Without
+        # this, client_ack would be a second forever-growing per-client
+        # map riding every snapshot.
+        for cid in [
+            c for c, a in self.client_ack.items()
+            if a <= self.client_watermark.get(c, 0)
+        ]:
+            del self.client_ack[cid]
         snap = self._checkpoint_snapshot()
         digest = snapshot_digest(snap)
         self.checkpoint_digests[seq] = digest
@@ -1619,11 +1690,17 @@ class Replica:
             # replica permanently diverged from the certified digest
             payload = json.loads(msg.snapshot)
             wm = payload["watermark"]
+            acks = payload.get("ack", {})
             replies = payload["replies"]
             app_snap = payload["app"]
-            if not isinstance(wm, dict) or not isinstance(replies, dict):
+            if (
+                not isinstance(wm, dict)
+                or not isinstance(replies, dict)
+                or not isinstance(acks, dict)
+            ):
                 raise ValueError("bad snapshot envelope")
             new_wm = {str(c): int(t) for c, t in wm.items()}
+            new_ack = {str(c): int(t) for c, t in acks.items()}
             restored: Dict[str, Dict[int, Reply]] = {}
             for c, per_ts in replies.items():
                 if not isinstance(per_ts, dict):
@@ -1638,6 +1715,7 @@ class Replica:
                 restored[str(c)] = inner
             self.app.restore(app_snap)  # last: commit point
             self.client_watermark = new_wm
+            self.client_ack = new_ack
             self.recent_replies = restored
         except (ValueError, TypeError, KeyError):
             self.metrics["bad_snapshot"] += 1

@@ -332,6 +332,19 @@ class Request(Message):
     ``timestamp`` is a client-chosen monotonic nonce (the reference used wall
     clock); (client_id, timestamp) identifies a request for reply matching
     and at-most-once execution.
+
+    ``ack`` is the client's signed retransmission floor: every own
+    timestamp <= ack is RESOLVED — answered (f+1 matches collected) or
+    abandoned (retries exhausted) — so the client will never retransmit
+    it. It is NOT proof of execution: an abandoned timestamp may or may
+    not have executed. Replicas use the floor to fold per-client
+    replay state (reply cache -> watermark) without ever folding a
+    timestamp that may still be in flight — a PIPELINED client (many
+    concurrent submits over one identity) otherwise races the checkpoint
+    fold: at high block rates the fold's seq-based horizon passes in
+    milliseconds, and a dropped-then-retried lower timestamp comes back
+    SUPERSEDED instead of executing. The floor rides inside executed
+    blocks, so every replica folds identically (checkpoint determinism).
     """
 
     KIND: ClassVar[str] = "request"
@@ -339,6 +352,7 @@ class Request(Message):
     client_id: str = ""
     timestamp: int = 0
     operation: str = ""
+    ack: int = 0
 
 
 @dataclass

@@ -1,0 +1,196 @@
+"""Directed tests for the signed completion floor (Request.ack).
+
+A pipelined client (many concurrent submits over one identity) races the
+checkpoint fold: the fold's horizon is measured in SEQS, so at high block
+rates it passes in milliseconds and a dropped-then-retried lower
+timestamp would come back SUPERSEDED instead of executing (the round-4
+'terminal stall under fading load' failure mode). The fix: each Request
+carries the client's signed completion floor — every own timestamp
+<= ack is fully answered — and the fold never crosses it (replica.py
+_emit_checkpoint), with a cap fallback bounding memory against clients
+that never declare. The reference has no analog: its client sends one
+request and exits without ever reading a reply (client.go:27-34), and
+its request pool keeps exactly one request per client (requestPool.go).
+"""
+
+import asyncio
+
+from simple_pbft_tpu.committee import LocalCommittee
+from simple_pbft_tpu.consensus.replica import RECENT_REPLIES_CAP
+from simple_pbft_tpu.messages import Reply
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def _mk_reply(client: str, ts: int, seq: int) -> Reply:
+    return Reply(client_id=client, timestamp=ts, seq=seq, result="ok")
+
+
+def test_fold_never_crosses_declared_floor():
+    """Entries above the client's floor survive folds while fresh (their
+    executing seq within STALE_FOLD_INTERVALS), no matter that the
+    one-interval horizon has long passed them."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1, checkpoint_interval=4)
+        r = com.replicas[0]
+        # client answered through ts=105; ts>=106 may still be in flight
+        r.client_ack["c0"] = 105
+        r.recent_replies["c0"] = {
+            ts: _mk_reply("c0", ts, seq=390) for ts in (104, 105, 106, 107)
+        }
+        # horizon (396) is past seq=390, stale bound (336) is not
+        await r._emit_checkpoint(400)
+        # at/below floor folded down to the top (105) which stays cached;
+        # above-floor entries untouched
+        assert set(r.recent_replies["c0"]) == {105, 106, 107}
+        assert r.client_watermark["c0"] == 105
+        # same-age fold again: still protected (stale bound 400-64=336)
+        await r._emit_checkpoint(420)
+        assert set(r.recent_replies["c0"]) == {105, 106, 107}
+        assert r.client_watermark["c0"] == 105
+
+    run(scenario())
+
+
+def test_departed_client_window_ages_out():
+    """A departed client's final in-flight window (floor never raised)
+    folds once STALE_FOLD_INTERVALS checkpoint intervals pass — it must
+    not ride every future snapshot forever."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1, checkpoint_interval=4)
+        r = com.replicas[0]
+        r.client_ack["c0"] = 105
+        r.recent_replies["c0"] = {
+            ts: _mk_reply("c0", ts, seq=390) for ts in (105, 106, 107)
+        }
+        # stale bound = 460 - 16*4 = 396 >= 390: everything ages out
+        await r._emit_checkpoint(460)
+        assert set(r.recent_replies["c0"]) == {107}  # top stays cached
+        assert r.client_watermark["c0"] == 107
+
+    run(scenario())
+
+
+def test_fold_cap_fallback_bounds_undeclared_client():
+    """A client that never declares a floor (ack=0) still folds by the
+    seq horizon once its reply cache exceeds the cap — replay-state
+    memory must not depend on client cooperation."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1, checkpoint_interval=4)
+        r = com.replicas[0]
+        n = RECENT_REPLIES_CAP + 10
+        r.recent_replies["c0"] = {
+            ts: _mk_reply("c0", ts, seq=ts) for ts in range(1, n + 1)
+        }
+        # below the cap nothing FRESH folds without a declaration
+        # (seq chosen past the horizon-minus-stale window)
+        r.recent_replies["c1"] = {
+            ts: _mk_reply("c1", ts, seq=n + 90) for ts in (1, 2, 3)
+        }
+        await r._emit_checkpoint(n + 100)
+        assert len(r.recent_replies["c0"]) == 1  # horizon fold, top kept
+        assert r.client_watermark["c0"] == n
+        assert set(r.recent_replies["c1"]) == {1, 2, 3}
+        assert "c1" not in r.client_watermark
+
+    run(scenario())
+
+
+def test_active_client_siblings_never_age_out():
+    """One fresh execution keeps the whole window alive: an ACTIVE
+    pipelined client's above-floor siblings must survive the stale
+    age-out no matter how old they are (sustained third-party load must
+    not reintroduce the fold race via the staleness rule)."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1, checkpoint_interval=4)
+        r = com.replicas[0]
+        r.client_ack["c0"] = 105
+        r.recent_replies["c0"] = {
+            105: _mk_reply("c0", 105, seq=10),   # ancient, at floor
+            107: _mk_reply("c0", 107, seq=10),   # ancient, above floor
+            109: _mk_reply("c0", 109, seq=458),  # fresh: client is alive
+        }
+        # stale bound = 460-64 = 396: 107 is way past it, but the fresh
+        # 109 (seq 458 > 396) vetoes the age-out for the whole window
+        await r._emit_checkpoint(460)
+        assert set(r.recent_replies["c0"]) == {105, 107, 109}
+        assert r.client_watermark["c0"] == 105
+
+    run(scenario())
+
+
+def test_quiesced_ack_entries_pruned():
+    """A floor at/below the watermark gates nothing and is dropped at
+    the next fold: departed clients leave only their watermark entry."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1, checkpoint_interval=4)
+        r = com.replicas[0]
+        r.client_ack["gone"] = 50
+        r.client_watermark["gone"] = 50
+        r.client_ack["live"] = 200
+        r.client_watermark["live"] = 150
+        await r._emit_checkpoint(400)
+        assert "gone" not in r.client_ack
+        assert r.client_ack["live"] == 200
+        assert r.client_watermark["gone"] == 50  # replay floor persists
+
+    run(scenario())
+
+
+def test_cap_counts_only_above_floor_entries():
+    """A declaring client whose recent below-floor executions exceed the
+    cap must NOT lose floor protection: the fallback counts only
+    above-floor (genuinely unfoldable) entries, since below-floor ones
+    fold within one interval by the horizon rule anyway."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1, checkpoint_interval=4)
+        r = com.replicas[0]
+        r.client_ack["c0"] = 600
+        recent = {
+            ts: _mk_reply("c0", ts, seq=390)
+            for ts in range(1, RECENT_REPLIES_CAP + 9)  # below floor
+        }
+        recent[700] = _mk_reply("c0", 700, seq=390)  # in flight (above)
+        recent[701] = _mk_reply("c0", 701, seq=390)
+        r.recent_replies["c0"] = recent
+        await r._emit_checkpoint(400)
+        top = RECENT_REPLIES_CAP + 8
+        assert set(r.recent_replies["c0"]) == {top, 700, 701}
+        assert r.client_watermark["c0"] == top  # floor never crossed
+
+    run(scenario())
+
+
+def test_ack_floor_rides_executed_blocks():
+    """End to end: sequential submits carry a rising floor, replicas pick
+    it up from executed blocks, and folds converge identically (the floor
+    is checkpoint state — divergence would split checkpoint digests)."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1, checkpoint_interval=4)
+        com.start()
+        try:
+            cl = com.clients[0]
+            for i in range(10):
+                assert await cl.submit(f"put k{i} v{i}") == "ok"
+            # submit() returns at f+1 matching replies — let the laggard
+            # replicas finish executing the last block before reading
+            await asyncio.sleep(0.3)
+            floors = {r.client_ack.get("c0", 0) for r in com.replicas}
+            assert len(floors) == 1
+            # floor = oldest-outstanding-1: after 10 serial submits it
+            # trails the last used timestamp (probe - 1) by exactly one
+            probe_ts = next(cl._ts)
+            assert floors.pop() == probe_ts - 2
+        finally:
+            await com.stop()
+
+    run(scenario())

@@ -66,3 +66,54 @@ def test_soak_faulty_network_prefix_agreement():
         await c.stop()
 
     asyncio.run(asyncio.wait_for(main(), 120))
+
+
+@pytest.mark.slow
+def test_fading_load_drain_tail_completes():
+    """Every request in flight when load STOPS must still commit and be
+    answered, with chaos still active.
+
+    Directed at the round-4 'terminal stall under fading load' wart
+    (bench_results/consensus_cpu_r04.jsonl line 1: the 128 requests in
+    flight at window end all timed out in the drain tail of a qc-n64
+    chaos run). The hazard is specific to fading load: most repair and
+    progress machinery — drain sweeps, slot probes, failover timers — is
+    (re)armed by arriving traffic, so the last requests' loss-repair must
+    be driven by the client-retry path alone. The reference has no
+    analog (its client never waits for replies at all, client.go:27-34).
+    """
+    async def main():
+        plan = FaultPlan(drop_rate=0.03, delay_range=(0.0, 0.02),
+                         duplicate_rate=0.01, seed=11)
+        c = LocalCommittee.build(n=7, clients=4, view_timeout=1.5,
+                                 checkpoint_interval=16, fault_plan=plan,
+                                 qc_mode=True)
+        for cl in c.clients:
+            cl.request_timeout = 1.5
+            cl.hedge = 2
+        c.start()
+        stop_at = time.perf_counter() + 8.0
+        tally = {"ok": 0, "gaveup": 0}
+
+        async def pump(cl, tag):
+            i = 0
+            while time.perf_counter() < stop_at:
+                try:
+                    # 20 retries x 1.5 s = 30 s patience: far beyond any
+                    # single failover, so a give-up here means the
+                    # committee truly stopped serving the drain tail
+                    await cl.submit(f"put {tag}{i} v{i}", retries=20)
+                    tally["ok"] += 1
+                except (asyncio.TimeoutError, TimeoutError):
+                    tally["gaveup"] += 1
+                i += 1
+
+        # 4 pumps per client: ~16 requests in flight when the load fades
+        await asyncio.gather(*(pump(cl, f"c{j}p{k}_")
+                               for j, cl in enumerate(c.clients)
+                               for k in range(4)))
+        assert tally["gaveup"] == 0, tally
+        assert tally["ok"] >= 32, tally
+        await c.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 120))
