@@ -128,37 +128,26 @@ async def run_config(
     from simple_pbft_tpu.transport.local import FaultPlan
 
     factory = None
+    n_keys = n + n_clients + 8  # committee + clients + headroom
     if verifier == "tpu":
         import simple_pbft_tpu
 
         simple_pbft_tpu.enable_jit_cache()
-        factory = lambda: TpuVerifier()  # noqa: E731
-        # warm the shared jit cache for every bucket this load can hit
-        # BEFORE the timed window — first compiles are ~30-40 s each
-        from simple_pbft_tpu.crypto import ed25519_cpu as _ref
-        from simple_pbft_tpu.crypto.verifier import BatchItem as _BI
-
-        seed = b"\xbb" * 32
-        pk = _ref.public_key(seed)
-        # a backup's drain sweep can batch a whole proposal (batch client
-        # sigs + 1) PLUS a round of votes from every peer — warm through
-        # that bucket or a 30-40 s compile lands inside the timed window
-        need = batch + 1 + 4 * n + 64
-        top = next((b for b in BUCKETS if b >= need), BUCKETS[-1])
-        warm = [
-            _BI(pk, b"warm %d" % i, _ref.sign(seed, b"warm %d" % i))
-            for i in range(8)
-        ]
-        warmer = TpuVerifier()
-        t0 = time.perf_counter()
-        for b in BUCKETS:
-            if b > top:
-                break
-            warmer.verify_batch((warm * ((b + 7) // 8))[:b])
-        print(
-            f"warmed buckets <= {top} in {time.perf_counter() - t0:.0f}s",
-            file=sys.stderr,
-        )
+        # initial_keys pins every replica's key-table SHAPE to the final
+        # key population: the jit signature includes that shape, so a
+        # bank growing under live traffic means fresh 40-150 s compiles
+        # serialized under the device lock mid-benchmark (measured: an
+        # n=16 run burning its whole 120 s client patience compiling,
+        # zero commits). Size once; warm at that exact shape below.
+        #
+        # ONE verifier shared by every replica: the committee shares one
+        # key population, and per-replica banks would upload n copies of
+        # the same table to one chip (n=64 at cap 128 is ~537 MB per
+        # bank — 34 GB across replicas, over any single chip's HBM).
+        # TpuVerifier is thread-safe (bank lock + device lock), exactly
+        # for this shape of sharing.
+        shared_verifier = TpuVerifier(initial_keys=n_keys)
+        factory = lambda: shared_verifier  # noqa: E731
 
     plan = None
     if chaos:
@@ -168,6 +157,14 @@ async def run_config(
             duplicate_rate=chaos["dup"],
             seed=chaos["seed"],
         )
+    # Degraded-mode (storm/chaos) failover timer: 3 s is right when
+    # verify is a local CPU call, but a tunneled device's sweep latency
+    # is itself seconds — a 3 s timer then fires before ANY round can
+    # finish and the committee view-changes perpetually from t=0
+    # (measured: storm-on-chip with verify_calls=0 — not one drain sweep
+    # completed). Scale the timer to the verify backend; co-located TPU
+    # deployments (ms dispatches) can pass --view-timeout to tighten it.
+    degraded_vt = 3.0 if verifier == "cpu" else 15.0
     com = LocalCommittee.build(
         n=n,
         clients=n_clients,
@@ -175,7 +172,7 @@ async def run_config(
         verifier_factory=factory,
         max_batch=batch,
         view_timeout=view_timeout
-        or (30.0 if not (storm or chaos) else 3.0),
+        or (30.0 if not (storm or chaos) else degraded_vt),
         checkpoint_interval=64,
         watermark_window=1024,
         qc_mode=qc_mode,
@@ -189,12 +186,36 @@ async def run_config(
         # Clean steady-state benches keep the long timeout so retries
         # never distort throughput numbers.
         degraded = storm or bool(chaos)
-        c.request_timeout = 1.5 * (view_timeout or 3.0) if degraded else 30.0
+        c.request_timeout = (
+            1.5 * (view_timeout or degraded_vt) if degraded else 30.0
+        )
         if degraded:
             # hedged first sends: a crashed primary or a dropped frame
             # must not leave the request unknown to the whole committee
             # (see client.Client.hedge)
             c.hedge = 2
+
+    if verifier == "tpu":
+        # Pre-pay every (bucket, table-shape) compile BEFORE the timed
+        # window, with the committee's REAL key population so the warmed
+        # shapes are the ones live sweeps hit. _shared_jit makes the
+        # compiles process-wide, so one warmer covers all n replicas.
+        # A backup's drain sweep can batch a whole proposal (batch
+        # client sigs + 1) plus a round of votes from every peer.
+        need = batch + 1 + 4 * n + 64
+        top = next((b for b in BUCKETS if b >= need), BUCKETS[-1])
+        t0 = time.perf_counter()
+        shared_verifier.warm(
+            pubkeys=[kp.pub for kp in com.keys.values()],
+            buckets=[b for b in BUCKETS if b <= top],
+        )
+        print(
+            f"warmed buckets <= {top} at table cap "
+            f"{shared_verifier._bank._cap} "
+            f"in {time.perf_counter() - t0:.0f}s",
+            file=sys.stderr,
+        )
+
     com.start()
 
     latencies: List[float] = []
@@ -257,6 +278,25 @@ async def run_config(
             (r.metrics.get("max_newview_bytes", 0) for r in com.replicas),
             default=0,
         )
+    # verify-batch occupancy (VERDICT r3 #3): sampled BEFORE com.stop()
+    # — stop() clears _running on every replica, which would always
+    # empty this snapshot. Calls/items/seconds are per-replica counters
+    # (replicas share one TpuVerifier but count their own calls); fresh
+    # = sig-cache misses that reached the device.
+    verify_stats = {}
+    if verifier == "tpu":
+        live = [r for r in com.replicas if r._running]
+        calls = sum(r.stats.verify_ms.count for r in live)
+        items_v = sum(r.stats.verify_items for r in live)
+        secs_v = sum(r.stats.verify_seconds for r in live)
+        verify_stats = dict(
+            verify_calls=calls,
+            verify_fresh_items=items_v,
+            verify_batch_mean=round(items_v / calls, 1) if calls else 0.0,
+            verify_ms_mean=round(1e3 * secs_v / calls, 1) if calls else 0.0,
+            verify_per_s_device=round(items_v / secs_v, 1) if secs_v else 0.0,
+        )
+
     await com.stop()
 
     lat_ms = sorted(x * 1e3 for _, x in latencies)
@@ -289,6 +329,7 @@ async def run_config(
         "repliers_cfg": com.cfg.repliers,
         "vs_reference_req_s": round(committed / window / 0.4, 1),  # ref ~0.4/s
     }
+    rec.update(verify_stats)
     rec.update(crash_info)
     return rec
 

@@ -39,19 +39,51 @@ def force_cpu() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def _cache_fingerprint() -> str:
+    """Hash of the local CPU's feature flags. Cache entries include
+    XLA:CPU AOT machine code; an entry compiled against a different
+    CPU's features — e.g. by the remote side of a device tunnel, whose
+    host advertises AMX/prefer-no-scatter this machine lacks — loads
+    with a warning and then wedges or SIGILLs at execution (observed:
+    every consensus --verifier tpu run deadlocking inside a cached
+    executable while holding the device lock). Namespacing the cache
+    directory by (backend, CPU flags) makes such entries unreachable."""
+    import hashlib
+    import platform
+
+    fp = platform.processor() or platform.machine() or "unknown"
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith(("flags", "features")):
+                    fp = line
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(fp.encode()).hexdigest()[:10]
+
+
 def enable_jit_cache(path: str | None = None) -> None:
     """Point JAX's persistent compilation cache at a shared directory so
     the crypto kernels (40-60 s compiles on small CPU hosts) compile once
     per machine, not once per process. Call before the first jit
     execution. Used by tests/conftest.py and the benchmarks; override the
-    location with SIMPLE_PBFT_JIT_CACHE or the `path` argument."""
+    location with SIMPLE_PBFT_JIT_CACHE or the `path` argument.
+
+    The directory is partitioned by CPU fingerprint — see
+    _cache_fingerprint for the cross-machine poisoning this prevents.
+    (Platform/backend is already part of JAX's own cache key, and
+    consulting jax.default_backend() here would INITIALIZE the ambient
+    backend — breaking callers like bench.py --smoke that select the
+    CPU platform after pointing the cache.)"""
     import os
 
     import jax
 
     uid = os.getuid() if hasattr(os, "getuid") else 0
-    cache = path or os.environ.get(
+    base = path or os.environ.get(
         "SIMPLE_PBFT_JIT_CACHE", f"/tmp/jax_cache_simple_pbft_{uid}"
     )
+    cache = os.path.join(base, f"host-{_cache_fingerprint()}")
     jax.config.update("jax_compilation_cache_dir", cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)

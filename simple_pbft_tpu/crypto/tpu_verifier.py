@@ -410,6 +410,61 @@ def prepare_comb_batch(
     return batch, fallback
 
 
+class WireBatch:
+    """Raw-bytes staging for the fused WIRE kernel: one packed (n, 96)
+    uint8 array (S ‖ k ‖ R per row) plus key rows and the precheck mask.
+
+    Window extraction, limb decomposition and the sign bit move onto the
+    device (ops/comb.fused_verify_wire_kernel), so this is ~100 bytes on
+    the host->device link per signature instead of ~290 — the e2e
+    throughput bound when the chip sits behind a network tunnel, and
+    saved HBM/PCIe traffic when it doesn't."""
+
+    def __init__(self, n: int, wire: np.ndarray, a_idx: np.ndarray,
+                 precheck: np.ndarray):
+        self.n = n
+        self._arrays = (wire, a_idx, precheck)
+
+    def arrays(self):
+        return self._arrays
+
+    def padded(self, size: int) -> "WireBatch":
+        """Zero-pad the batch (leading) dim up to `size`; keeps n = the
+        pre-pad item count (pad rows carry precheck=False)."""
+        if size == self.n:
+            return self
+        wire, a_idx, precheck = self._arrays
+        pad = size - self.n
+        assert pad > 0, (size, self.n)
+        return WireBatch(
+            self.n,
+            np.pad(wire, ((0, pad), (0, 0))),
+            np.pad(a_idx, (0, pad)),
+            np.pad(precheck, (0, pad)),
+        )
+
+
+def prepare_wire_batch(
+    items: Sequence[BatchItem], bank: KeyBank
+) -> "tuple[WireBatch, List[int]]":
+    """Wire bytes -> WireBatch for the fused wire kernel (same contract
+    as prepare_comb_batch: returns (batch, fallback positions)). Host
+    work is only the byte joins, the bank lookup, the native challenge
+    hash and the canonicality prechecks — no window/limb unpacking."""
+    n = len(items)
+    pub, r_raw, s_raw, msgs, ok = _split_items(items)
+    a_idx, hit, fallback = bank.lookup_many(items)
+    ok &= hit
+
+    k_raw = native.challenge_batch(r_raw, pub, msgs)
+
+    ok &= ~_ge_l_np(s_raw)
+    ok &= ~_ge_p_np(r_raw)
+
+    wire = np.concatenate([s_raw, k_raw, r_raw], axis=1)  # (n, 96) uint8
+    return WireBatch(n, wire, a_idx.astype(np.int32), ok), fallback
+
+
 _JIT_CACHE: Dict[str, object] = {}
 
 # One device pass at a time, process-wide. The replica runtime calls
@@ -431,7 +486,12 @@ def _shared_jit(mode: str):
     practical deadlock on single-core CI hosts)."""
     fn = _JIT_CACHE.get(mode)
     if fn is None:
-        if mode.startswith("fused"):
+        if mode.startswith("wire"):
+            window = 1 << int(mode[4:] or "4")  # "wire" / "wire5" / "wire6"
+            kernel = functools.partial(
+                comb.fused_verify_wire_kernel, window=window
+            )
+        elif mode.startswith("fused"):
             window = 1 << int(mode[5:] or "4")  # "fused" / "fused5" / "fused6"
             kernel = functools.partial(comb.fused_verify_kernel, window=window)
         else:
@@ -467,14 +527,28 @@ class TpuVerifier:
         mesh: Optional[jax.sharding.Mesh] = None,
         mode: str = "fused",
         window: int = 4,
+        initial_keys: Optional[int] = None,
     ):
         assert mode in ("comb", "fused", "ladder")
         assert window == 4 or mode == "fused", "window is a fused-mode knob"
         self._mesh = mesh
         self._mode = mode
         self._window = window
+        # initial_keys sizes the bank for the EXPECTED key population
+        # (committee + clients). This is not an optimization nicety: the
+        # jit signature includes the table shape, which is a function of
+        # the bank's capacity — letting the bank grow 8 -> 16 -> 32 under
+        # live traffic means each (bucket, capacity) pair is a FRESH
+        # 40-150 s compile, serialized under the device lock across every
+        # replica in the process (measured: an n=16 committee spending
+        # its entire 120 s client patience inside back-to-back compiles,
+        # committing nothing). A PBFT deployment knows its key set up
+        # front — size the bank once and the shape never moves.
+        cap = 8
+        if initial_keys is not None:
+            cap = 1 << max(3, int(initial_keys - 1).bit_length())
         self._bank = (
-            KeyBank(mode=mode, window=window)
+            KeyBank(initial_capacity=cap, mode=mode, window=window)
             if mode in ("comb", "fused")
             else None
         )
@@ -507,15 +581,17 @@ class TpuVerifier:
 
                 from jax.sharding import PartitionSpec as PS
 
+                # wire kernel: args are (wire (B,96), a_idx (B,),
+                # f_table (replicated), precheck (B,)) — batch axis
+                # LEADS the wire array, so shards split rows
                 self._fn = jax.jit(
                     shard_map(
                         functools.partial(
-                            comb.fused_verify_kernel, window=1 << window
+                            comb.fused_verify_wire_kernel, window=1 << window
                         ),
                         mesh=mesh,
                         in_specs=(
-                            PS(None, axis), PS(None, axis), PS(axis),
-                            PS(None, None), PS(None, axis), PS(axis),
+                            PS(axis, None), PS(axis), PS(None, None),
                             PS(axis),
                         ),
                         out_specs=PS(axis),
@@ -538,9 +614,36 @@ class TpuVerifier:
                     f"{self._align} devices"
                 )
         else:
-            key = mode if window == 4 else f"fused{window}"
+            if mode == "fused":  # fused staging is the wire path
+                key = "wire" if window == 4 else f"wire{window}"
+            else:
+                key = mode
             self._fn = _shared_jit(key)
             self._align = 1
+
+    def warm(
+        self,
+        pubkeys: Sequence[bytes] = (),
+        buckets: Sequence[int] = (8,),
+    ) -> None:
+        """Pre-pay every device compile this verifier will hit under
+        traffic: register the known key population (committee members +
+        enrolled clients — a PBFT deployment publishes these up front),
+        then run one throwaway device pass per batch bucket at the
+        resulting table shape. Because the jitted kernels are shared
+        process-wide (_shared_jit), warming ONE verifier warms every
+        replica in a simulated committee — provided they were built with
+        the same initial_keys, so their table shapes match."""
+        if self._bank is not None:
+            for pk in pubkeys:
+                self._bank.lookup(pk)
+        # wrong-length pubkey: _split_items masks the row and the bank
+        # rejects it without registering — an all-zero 32-byte key would
+        # decompress to a valid (order-4) point and permanently occupy a
+        # bank slot, skewing the very capacity this warmup pins
+        dummy = BatchItem(bytes(31), b"", bytes(64))
+        for b in buckets:
+            self.verify_batch([dummy] * b)
 
     def verify_batch(self, items: Sequence[BatchItem]) -> List[bool]:
         if not items:
@@ -555,15 +658,19 @@ class TpuVerifier:
     def _verify_chunk(self, items: Sequence[BatchItem]) -> List[bool]:
         size = _bucket_size(max(len(items), self._align))
         if self._mode in ("comb", "fused"):
-            prep, fallback = prepare_comb_batch(items, self._bank)
-            prep = prep.padded(size)
-            s_nib, k_nib, a_idx, r_y, r_sign, precheck = prep.arrays()
-            tables = self._bank.device_tables()
-            if self._mode == "comb":
+            if self._mode == "fused":
+                prep, fallback = prepare_wire_batch(items, self._bank)
+                prep = prep.padded(size)
+                wire, a_idx, precheck = prep.arrays()
+                tables = self._bank.device_tables()
+                args = (wire, a_idx, tables, precheck)
+            else:
+                prep, fallback = prepare_comb_batch(items, self._bank)
+                prep = prep.padded(size)
+                s_nib, k_nib, a_idx, r_y, r_sign, precheck = prep.arrays()
+                tables = self._bank.device_tables()
                 b_table = comb.base_table_device()
                 args = (s_nib, k_nib, a_idx, tables, b_table, r_y, r_sign, precheck)
-            else:
-                args = (s_nib, k_nib, a_idx, tables, r_y, r_sign, precheck)
             # np.array (copy): fallback rows below are written in place
             with _DEVICE_LOCK:
                 verdict = np.array(self._fn(*args))

@@ -493,6 +493,36 @@ def fused_verify_kernel(
     return _encode_and_compare(p, r_y, r_sign, precheck)
 
 
+def fused_verify_wire_kernel(
+    wire: jnp.ndarray,  # (B, 96) uint8 — S (32) ‖ k (32) ‖ R (32) raw bytes
+    a_index: jnp.ndarray,  # (B,) int32 — key row into the fused table bank
+    f_table: jnp.ndarray,  # (n_keys*npos*window^2, ROW) packed Niels rows
+    precheck: jnp.ndarray,  # (B,) bool — host-side validity mask
+    window: int = WINDOW,
+    accum: Optional[str] = None,
+) -> jnp.ndarray:
+    """fused_verify_kernel taking RAW wire bytes, one packed (B, 96)
+    uint8 array per batch: scalar-window extraction, R limb decomposition
+    and the sign bit all happen on device (fe.extract_windows_dev).
+
+    This is the transfer-lean staging path: ~100 bytes/item cross the
+    host->device link instead of ~290 (int32 windows + limbs), and the
+    host sheds the unpack work. XLA fuses the byte shuffling into the
+    kernel prologue — measured device rate is unchanged; e2e rate is
+    what improves (it is transfer/host-bound, especially over a
+    tunneled device)."""
+    wbits = window.bit_length() - 1
+    npos = npos_for(wbits)
+    s_w = fe.extract_windows_dev(wire[:, 0:32], wbits, npos)
+    k_w = fe.extract_windows_dev(wire[:, 32:64], wbits, npos)
+    r_y = fe.extract_windows_dev(wire[:, 64:96], fe.RADIX, fe.NLIMB)
+    r_sign = wire[:, 95].astype(jnp.int32) >> 7
+    return fused_verify_kernel(
+        s_w, k_w, a_index, f_table, r_y, r_sign, precheck,
+        window=window, accum=accum,
+    )
+
+
 def comb_verify_kernel(
     s_nibbles: jnp.ndarray,  # (NPOS, B) int32 — S scalar nibbles
     k_nibbles: jnp.ndarray,  # (NPOS, B) int32 — challenge scalar nibbles

@@ -129,6 +129,44 @@ def test_fused_window5_matches_oracle():
     assert v5.verify_batch(items) == oracle == [True, True, True, False]
 
 
+def test_wire_kernel_matches_host_prep():
+    """The wire kernel (raw (B, 96) bytes, on-device unpack) must be
+    bit-identical to the host-prepped fused kernel for every window
+    width — same verdicts on valid, tampered and padding rows."""
+    import jax
+
+    from simple_pbft_tpu.crypto.tpu_verifier import (
+        KeyBank,
+        prepare_comb_batch,
+        prepare_wire_batch,
+    )
+    from simple_pbft_tpu.ops import comb
+
+    good = [_signed(i, b"wire %d" % i) for i in range(5)]
+    bad = BatchItem(good[0].pubkey, b"altered", good[0].sig)
+    items = good + [bad]
+    for w in (4, 5, 6):
+        bank = KeyBank(mode="fused", window=w)
+        hp, _ = prepare_comb_batch(items, bank)
+        hp = hp.padded(8)
+        s_nib, k_nib, a_idx, r_y, r_sign, pre = hp.arrays()
+        tables = bank.device_tables()
+        want = np.asarray(
+            jax.jit(comb.fused_verify_kernel, static_argnames=("window",))(
+                s_nib, k_nib, a_idx, tables, r_y, r_sign, pre, window=1 << w
+            )
+        )
+        wp, _ = prepare_wire_batch(items, bank)
+        wire, wa_idx, wpre = wp.padded(8).arrays()
+        got = np.asarray(
+            jax.jit(
+                comb.fused_verify_wire_kernel, static_argnames=("window",)
+            )(wire, wa_idx, tables, wpre, window=1 << w)
+        )
+        assert (got == want).all(), (w, got, want)
+        assert got[: len(items)].tolist() == [True] * 5 + [False]
+
+
 def test_keybank_cap_falls_back_to_cpu():
     """Keys beyond the bank cap must still verify correctly (CPU path),
     and the bank must not grow past max_keys."""

@@ -173,6 +173,63 @@ def next_experiment(results: list[dict]) -> dict | None:
                 "--crashes", "1", "--seconds", "45",
             ],
         )
+    # Retries with the round-4 mid-queue fixes. consensus_*b: the first
+    # attempts all zero-committed inside a compile storm — the key-table
+    # shape grew under live traffic, so every (bucket, capacity) pair
+    # was a fresh compile serialized under the device lock (fixed:
+    # TpuVerifier initial_keys + warm() at the final shape, and the
+    # poisoned cross-machine jit cache is now namespaced by CPU).
+    if ready("consensus_n16b"):
+        return _consensus_exp(
+            "consensus_n16b",
+            ["--configs", "2", "--verifier", "tpu", "--seconds", "20"],
+        )
+    if ready("consensus_n64b"):
+        return _consensus_exp(
+            "consensus_n64b",
+            ["--configs", "3", "--verifier", "tpu", "--seconds", "30"],
+        )
+    if ready("consensus_storm_qc64b"):
+        return _consensus_exp(
+            "consensus_storm_qc64b",
+            [
+                "--configs", "qc64", "--verifier", "tpu", "--storm",
+                "--crashes", "1", "--seconds", "45",
+            ],
+        )
+    # Longer windows: the n=64 first wave takes ~40 s on the tunneled
+    # one-core host (completed 128/128 with zero give-ups but past the
+    # 30 s window, so committed_req_s read 0). 90-120 s shows the real
+    # steady state.
+    if ready("consensus_n16c"):
+        return _consensus_exp(
+            "consensus_n16c",
+            ["--configs", "2", "--verifier", "tpu", "--seconds", "60"],
+        )
+    if ready("consensus_n64c"):
+        return _consensus_exp(
+            "consensus_n64c",
+            ["--configs", "3", "--verifier", "tpu", "--seconds", "120"],
+            timeout=3000.0,
+        )
+    if ready("consensus_storm_qc64c"):
+        # with the verifier-aware degraded view timeout (15 s on a
+        # tunneled device — 3 s fired before any round could finish)
+        return _consensus_exp(
+            "consensus_storm_qc64c",
+            [
+                "--configs", "qc64", "--verifier", "tpu", "--storm",
+                "--crashes", "1", "--seconds", "90",
+            ],
+            timeout=3000.0,
+        )
+    # w6 retry with the tables-as-argument fix (the original attempts died
+    # compiling: the 720 MB closed-over table was lowered as a program
+    # constant) and a budget that tolerates a genuinely slow compile.
+    if ready("verify_w6b"):
+        return _bench_exp(
+            "verify_w6b", {"BENCH_WINDOW": "6"}, timeout=2400.0
+        )
     return None
 
 
