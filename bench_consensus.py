@@ -215,6 +215,10 @@ async def run_config(
             f"in {time.perf_counter() - t0:.0f}s",
             file=sys.stderr,
         )
+        # occupancy counters start at the timed window, not the warmup
+        shared_verifier.device_calls = 0
+        shared_verifier.device_items = 0
+        shared_verifier.device_seconds = 0.0
 
     com.start()
 
@@ -280,21 +284,31 @@ async def run_config(
         )
     # verify-batch occupancy (VERDICT r3 #3): sampled BEFORE com.stop()
     # — stop() clears _running on every replica, which would always
-    # empty this snapshot. Calls/items/seconds are per-replica counters
-    # (replicas share one TpuVerifier but count their own calls); fresh
-    # = sig-cache misses that reached the device.
+    # empty this snapshot. Device-side numbers come from the SHARED
+    # verifier's own counters, measured inside the device lock by the
+    # holder: summing caller-side wall clocks across n replicas counts
+    # lock wait once per blocked caller (up to n x underreport).
     verify_stats = {}
     if verifier == "tpu":
-        live = [r for r in com.replicas if r._running]
-        calls = sum(r.stats.verify_ms.count for r in live)
-        items_v = sum(r.stats.verify_items for r in live)
-        secs_v = sum(r.stats.verify_seconds for r in live)
+        v = shared_verifier
         verify_stats = dict(
-            verify_calls=calls,
-            verify_fresh_items=items_v,
-            verify_batch_mean=round(items_v / calls, 1) if calls else 0.0,
-            verify_ms_mean=round(1e3 * secs_v / calls, 1) if calls else 0.0,
-            verify_per_s_device=round(items_v / secs_v, 1) if secs_v else 0.0,
+            verify_calls=v.device_calls,
+            verify_fresh_items=v.device_items,
+            verify_batch_mean=(
+                round(v.device_items / v.device_calls, 1)
+                if v.device_calls
+                else 0.0
+            ),
+            verify_ms_mean=(
+                round(1e3 * v.device_seconds / v.device_calls, 1)
+                if v.device_calls
+                else 0.0
+            ),
+            verify_per_s_device=(
+                round(v.device_items / v.device_seconds, 1)
+                if v.device_seconds
+                else 0.0
+            ),
         )
 
     await com.stop()

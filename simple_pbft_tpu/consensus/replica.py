@@ -460,10 +460,14 @@ class Replica:
                 while len(cache) > self.SIG_CACHE_MAX:
                     cache.popitem(last=False)
         self.metrics["sig_cache_hits"] += len(items) - len(fresh)
-        dt = time.perf_counter() - t0
-        self.stats.verify_ms.record(dt * 1e3)
-        self.stats.verify_items += len(fresh)
-        self.stats.verify_seconds += dt
+        if fresh:
+            # cache-hit-only sweeps never reach the device; recording
+            # their ~0 ms samples would dilute verify batch-size and
+            # latency stats toward zero
+            dt = time.perf_counter() - t0
+            self.stats.verify_ms.record(dt * 1e3)
+            self.stats.verify_items += len(fresh)
+            self.stats.verify_seconds += dt
         return out
 
     async def _finish_sweep(self, decoded, spans, verify_task) -> None:

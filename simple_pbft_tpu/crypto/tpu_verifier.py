@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -287,7 +288,11 @@ class KeyBank:
         self._index: Dict[bytes, int] = {}
         self._invalid_cache: set = set()
         self._max_keys = default_max if max_keys is None else max_keys
-        self._cap = initial_capacity
+        # clamp: capacity beyond max_keys would allocate (and upload)
+        # table memory the lookup path refuses to ever use — at w=6 a
+        # 64-slot bank is ~2.9 GB against the ~1 GB budget max_keys
+        # enforces
+        self._cap = max(1, min(initial_capacity, self._max_keys))
         self._np = np.zeros((self._cap, self._rows_per_key, comb.ROW), np.int32)
         self._dev = None
         self._dirty = True
@@ -388,15 +393,7 @@ def prepare_comb_batch(
     scalars come from the native batch hasher.
     """
     n = len(items)
-    pub, r_raw, s_raw, msgs, ok = _split_items(items)
-    a_idx, hit, fallback = bank.lookup_many(items)
-    ok &= hit
-
-    k_raw = native.challenge_batch(r_raw, pub, msgs)
-
-    ok &= ~_ge_l_np(s_raw)
-    ok &= ~_ge_p_np(r_raw)
-
+    s_raw, k_raw, r_raw, a_idx, ok, fallback = _decode_and_precheck(items, bank)
     wbits = getattr(bank, "window", 4)
     batch = CombBatch(
         n,
@@ -444,14 +441,12 @@ class WireBatch:
         )
 
 
-def prepare_wire_batch(
-    items: Sequence[BatchItem], bank: KeyBank
-) -> "tuple[WireBatch, List[int]]":
-    """Wire bytes -> WireBatch for the fused wire kernel (same contract
-    as prepare_comb_batch: returns (batch, fallback positions)). Host
-    work is only the byte joins, the bank lookup, the native challenge
-    hash and the canonicality prechecks — no window/limb unpacking."""
-    n = len(items)
+def _decode_and_precheck(items: Sequence[BatchItem], bank: KeyBank):
+    """Shared prologue of both staging paths: wire-byte split, bank
+    lookup, native challenge scalars, and the canonicality reject
+    policy (S >= L malleability, non-canonical R.y). Single-sourced so
+    the comb and wire device paths can never diverge in what they
+    reject. -> (s_raw, k_raw, r_raw, a_idx, ok, fallback)."""
     pub, r_raw, s_raw, msgs, ok = _split_items(items)
     a_idx, hit, fallback = bank.lookup_many(items)
     ok &= hit
@@ -460,7 +455,18 @@ def prepare_wire_batch(
 
     ok &= ~_ge_l_np(s_raw)
     ok &= ~_ge_p_np(r_raw)
+    return s_raw, k_raw, r_raw, a_idx, ok, fallback
 
+
+def prepare_wire_batch(
+    items: Sequence[BatchItem], bank: KeyBank
+) -> "tuple[WireBatch, List[int]]":
+    """Wire bytes -> WireBatch for the fused wire kernel (same contract
+    as prepare_comb_batch: returns (batch, fallback positions)). Host
+    work is only the byte joins, the bank lookup, the native challenge
+    hash and the canonicality prechecks — no window/limb unpacking."""
+    n = len(items)
+    s_raw, k_raw, r_raw, a_idx, ok, fallback = _decode_and_precheck(items, bank)
     wire = np.concatenate([s_raw, k_raw, r_raw], axis=1)  # (n, 96) uint8
     return WireBatch(n, wire, a_idx.astype(np.int32), ok), fallback
 
@@ -620,6 +626,16 @@ class TpuVerifier:
                 key = mode
             self._fn = _shared_jit(key)
             self._align = 1
+        # Device-side accounting, owned by the verifier: seconds are
+        # measured INSIDE the device lock by the holder, so they are
+        # dispatch+execute time only. Summing caller-side wall clocks
+        # across N replicas sharing this verifier counts lock WAIT once
+        # per blocked caller and underreports the device rate by up to
+        # N x. Monotonic (read-only) counters; the device lock already
+        # serializes writers.
+        self.device_calls = 0
+        self.device_items = 0
+        self.device_seconds = 0.0
 
     def warm(
         self,
@@ -673,7 +689,11 @@ class TpuVerifier:
                 args = (s_nib, k_nib, a_idx, tables, b_table, r_y, r_sign, precheck)
             # np.array (copy): fallback rows below are written in place
             with _DEVICE_LOCK:
+                t0 = time.perf_counter()
                 verdict = np.array(self._fn(*args))
+                self.device_seconds += time.perf_counter() - t0
+                self.device_calls += 1
+                self.device_items += len(items)
             if fallback:  # keys over the bank cap: CPU path
                 for i in fallback:
                     it = items[i]
@@ -681,5 +701,9 @@ class TpuVerifier:
         else:
             prep = prepare_batch(items).padded(size)
             with _DEVICE_LOCK:
+                t0 = time.perf_counter()
                 verdict = np.asarray(self._fn(*prep.arrays()))
+                self.device_seconds += time.perf_counter() - t0
+                self.device_calls += 1
+                self.device_items += len(items)
         return verdict[: prep.n].tolist()

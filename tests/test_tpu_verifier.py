@@ -167,6 +167,38 @@ def test_wire_kernel_matches_host_prep():
         assert got[: len(items)].tolist() == [True] * 5 + [False]
 
 
+def test_initial_keys_pins_table_shape_and_warm_is_inert():
+    """TpuVerifier(initial_keys=...) must fix the bank capacity so live
+    traffic never grows it (a growth means a fresh kernel compile under
+    the device lock — the bug that zeroed every consensus-on-chip run),
+    and warm() must not register its dummy row into the bank."""
+    v = TpuVerifier(initial_keys=20)
+    assert v._bank._cap == 32  # next power of two
+    v.warm(buckets=[8])
+    assert len(v._bank._index) == 0  # dummy never registered
+    items = [_signed(i, b"pin %d" % i) for i in range(6)]
+    assert v.verify_batch(items) == [True] * 6
+    assert v._bank._cap == 32  # capacity untouched by traffic
+
+
+def test_jit_cache_dir_is_host_namespaced(tmp_path):
+    """enable_jit_cache must partition by CPU fingerprint (cross-machine
+    XLA:CPU AOT entries wedge at execution) and must not initialize a
+    backend to do it."""
+    import jax
+
+    from simple_pbft_tpu import _cache_fingerprint, enable_jit_cache
+
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        enable_jit_cache(str(tmp_path))
+        got = jax.config.jax_compilation_cache_dir
+        assert got == str(tmp_path / f"host-{_cache_fingerprint()}")
+        assert _cache_fingerprint() == _cache_fingerprint()  # stable
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
 def test_keybank_cap_falls_back_to_cpu():
     """Keys beyond the bank cap must still verify correctly (CPU path),
     and the bank must not grow past max_keys."""
