@@ -346,21 +346,64 @@ def _worker_main() -> None:
     # dispatch. Dispatches are async, so the device verifies batch k while
     # the host preps batch k+1 — the overlap the pipelined runtime gets.
     e2e_rate = 0.0
+    e2e_pipe_rate = 0.0
     if _best["batch"]:
         b_best = _best["batch"]
         items_big = items * (b_best // distinct)
         _best["note"] = f"e2e at batch={b_best}; best: {best_note}"
-        out = None
-        iters = 0
-        t0 = time.perf_counter()
-        while iters < 50 and (iters < 3 or time.perf_counter() - t0 < 3.0):
-            prep_i, _fb = prepare(items_big, bank)
-            out = fn(
-                *const_args, *(jax.device_put(a) for a in prep_i.arrays())
-            )
-            iters += 1
-        out.block_until_ready()
-        e2e_rate = b_best * iters / (time.perf_counter() - t0)
+
+        def put_dispatch(arrays):
+            return fn(*const_args, *(jax.device_put(a) for a in arrays))
+
+        def e2e_loop(dispatch, finish) -> float:
+            """One closed prepare->dispatch loop; `finish(last)` blocks
+            on the final in-flight work. Shared by the serial and
+            pipelined variants so the cutoff policy lives once."""
+            last = None
+            iters = 0
+            t0 = time.perf_counter()
+            while iters < 50 and (
+                iters < 3 or time.perf_counter() - t0 < 3.0
+            ):
+                prep_i, _fb = prepare(items_big, bank)
+                last = dispatch(prep_i.arrays(), last)
+                iters += 1
+            finish(last)
+            return b_best * iters / (time.perf_counter() - t0)
+
+        def remaining() -> float:
+            return budget - (time.perf_counter() - t_start)
+
+        # Guarded like the profiler capture above: an e2e failure (e.g. a
+        # tunnel hiccup mid-transfer) must not discard the device-rate
+        # measurement already in hand. Budget-checked so a slow-prep
+        # config can't ride into the watchdog and lose the whole record.
+        try:
+            if remaining() > 0.10 * budget:
+                e2e_rate = e2e_loop(
+                    lambda arrays, _prev: put_dispatch(arrays),
+                    lambda last: last.block_until_ready(),
+                )
+            # Pipelined: host prep of batch k+1 overlaps transfer +
+            # device pass of batch k (a worker thread owns put+dispatch;
+            # JAX dispatch is thread-safe) — the overlap the replica
+            # runtime's two-worker verify pipeline gets for free.
+            if remaining() > 0.10 * budget:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(1) as pool:
+
+                    def disp(arrays, prev):
+                        if prev is not None:
+                            prev.result()  # keep queue depth at 1
+                        return pool.submit(put_dispatch, arrays)
+
+                    e2e_pipe_rate = e2e_loop(
+                        disp,
+                        lambda last: last.result().block_until_ready(),
+                    )
+        except Exception as e:  # noqa: BLE001
+            print(f"e2e measurement failed: {e!r}", file=sys.stderr)
         _best["note"] = best_note
 
     print(
@@ -372,6 +415,7 @@ def _worker_main() -> None:
     _emit(
         host_prep_us_per_item=round(prep_per_item_us, 2),
         e2e_verifies_per_sec=round(e2e_rate, 1),
+        e2e_pipelined_verifies_per_sec=round(e2e_pipe_rate, 1),
         table_build_s=round(table_build_s, 1),
         staging="wire" if mode == "fused" else "prep",
         platform=platform,
