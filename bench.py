@@ -414,8 +414,12 @@ def _worker_main() -> None:
     )
     _emit(
         host_prep_us_per_item=round(prep_per_item_us, 2),
-        e2e_verifies_per_sec=round(e2e_rate, 1),
-        e2e_pipelined_verifies_per_sec=round(e2e_pipe_rate, 1),
+        # null = not measured (budget skip / failure) — a literal 0.0
+        # would read as a catastrophic regression in the jsonl record
+        e2e_verifies_per_sec=round(e2e_rate, 1) if e2e_rate else None,
+        e2e_pipelined_verifies_per_sec=(
+            round(e2e_pipe_rate, 1) if e2e_pipe_rate else None
+        ),
         table_build_s=round(table_build_s, 1),
         staging="wire" if mode == "fused" else "prep",
         platform=platform,

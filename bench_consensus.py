@@ -124,7 +124,7 @@ async def run_config(
     max_crashes: int = 3,
 ) -> dict:
     from simple_pbft_tpu.committee import LocalCommittee
-    from simple_pbft_tpu.crypto.tpu_verifier import BUCKETS, TpuVerifier
+    from simple_pbft_tpu.crypto.tpu_verifier import TpuVerifier
     from simple_pbft_tpu.transport.local import FaultPlan
 
     factory = None
@@ -203,14 +203,12 @@ async def run_config(
         # A backup's drain sweep can batch a whole proposal (batch
         # client sigs + 1) plus a round of votes from every peer.
         need = batch + 1 + 4 * n + 64
-        top = next((b for b in BUCKETS if b >= need), BUCKETS[-1])
         t0 = time.perf_counter()
-        shared_verifier.warm(
-            pubkeys=[kp.pub for kp in com.keys.values()],
-            buckets=[b for b in BUCKETS if b <= top],
+        shared_verifier.warm_for_population(
+            [kp.pub for kp in com.keys.values()], max_sweep=need
         )
         print(
-            f"warmed buckets <= {top} at table cap "
+            f"warmed sweeps <= {need} at table cap "
             f"{shared_verifier._bank._cap} "
             f"in {time.perf_counter() - t0:.0f}s",
             file=sys.stderr,

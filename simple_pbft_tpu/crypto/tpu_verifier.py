@@ -637,6 +637,44 @@ class TpuVerifier:
         self.device_items = 0
         self.device_seconds = 0.0
 
+    @classmethod
+    def for_population(
+        cls,
+        pubkeys: Sequence[bytes],
+        max_sweep: int,
+        headroom: int = 32,
+        **kwargs,
+    ) -> "TpuVerifier":
+        """Build + warm a verifier for a known deployment in one step:
+        size the bank to the published key population (+headroom for
+        walk-in client keys) and pre-pay every device compile a drain
+        sweep of up to `max_sweep` items can hit. THE constructor for
+        production nodes — an unsized bank recompiles (minutes, under
+        the device lock) the first time live traffic grows it."""
+        v = cls(initial_keys=len(pubkeys) + headroom, **kwargs)
+        v.warm_for_population(pubkeys, max_sweep)
+        return v
+
+    def warm_for_population(
+        self, pubkeys: Sequence[bytes], max_sweep: int
+    ) -> None:
+        """Register the key population and warm every batch bucket up
+        to the one covering `max_sweep` items. Single-sourced bucket
+        policy for node.py and the committee benches. Logs when the
+        population exceeds the bank budget — over-cap keys fall back to
+        the per-batch CPU path forever, which is safe but silently
+        forfeits the device for those signers."""
+        if self._bank is not None and len(pubkeys) > self._bank._max_keys:
+            import logging
+
+            logging.warning(
+                "TpuVerifier bank clamped: %d published keys > max_keys=%d "
+                "(window=%d); over-cap keys verify on the CPU fallback path",
+                len(pubkeys), self._bank._max_keys, self._window,
+            )
+        top = _bucket_size(max(1, min(max_sweep, BUCKETS[-1])))
+        self.warm(pubkeys=pubkeys, buckets=[b for b in BUCKETS if b <= top])
+
     def warm(
         self,
         pubkeys: Sequence[bytes] = (),

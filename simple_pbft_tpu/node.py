@@ -36,11 +36,22 @@ def make_transport(name: str, node_id: str, dep: "deploy.Deployment"):
     )
 
 
-def make_verifier(name: str):
+def make_verifier(name: str, dep=None):
     if name == "tpu":
         from .crypto.tpu_verifier import TpuVerifier
 
-        return TpuVerifier()
+        if dep is None:
+            return TpuVerifier()
+        # Size the key bank to the deployment's published key population
+        # and pre-pay the device compiles before serving traffic: the
+        # jit signature includes the table shape, so a bank growing
+        # under live traffic means minutes-long compiles mid-consensus
+        # (the round-4 consensus-on-chip zero-commit bug). max_sweep is
+        # the replica's drain bound — every bucket a live sweep can hit
+        # is warmed at boot.
+        return TpuVerifier.for_population(
+            list(dep.cfg.pubkeys.values()), max_sweep=4096
+        )
     if name == "cpu":
         return best_cpu_verifier()
     if name == "cpu-pure":
@@ -60,7 +71,7 @@ async def run_node(args) -> None:
         cfg=dep.cfg,
         seed=seed,
         transport=transport,
-        verifier=make_verifier(args.verifier),
+        verifier=make_verifier(args.verifier, dep),
     )
     replica.start()
     logging.info(

@@ -176,6 +176,48 @@ class TestDeployDocs:
             seed = deploy.read_seed(str(tmp_path), node)
             assert len(seed) == 32
 
+    def test_node_tpu_verifier_sized_and_warmed_from_deploy(self, tmp_path):
+        """node.py's tpu backend must size the key bank to the deploy
+        doc's key population and pre-register those keys (the jit table
+        shape must never move under live traffic — round-4
+        consensus-on-chip fix)."""
+        from unittest import mock
+
+        from simple_pbft_tpu.crypto.tpu_verifier import TpuVerifier
+        from simple_pbft_tpu.node import make_verifier
+
+        deploy.generate(str(tmp_path), n=4, clients=2, base_port=7410)
+        dep = deploy.load(str(tmp_path / "committee.json"))
+        # warm only the smallest bucket here: the full (8..512) boot
+        # warm compiles 4 kernels (~minutes cold), covered by the chip
+        # path; this test pins the sizing/registration contract
+        real_warm = TpuVerifier.warm
+        with mock.patch.object(
+            TpuVerifier,
+            "warm",
+            lambda self, pubkeys=(), buckets=(8,): real_warm(
+                self, pubkeys, (8,)
+            ),
+        ):
+            v = make_verifier("tpu", dep)
+        n_keys = len(dep.cfg.pubkeys)
+        assert len(v._bank._index) == n_keys  # all published keys cached
+        cap = v._bank._cap
+        assert cap >= n_keys + 32  # headroom for walk-in client keys
+        # live traffic — including a WALK-IN key the deploy doc never
+        # published — must not grow the table (growth = a fresh kernel
+        # compile under the device lock mid-consensus)
+        from simple_pbft_tpu.crypto import ed25519_cpu as ref
+        from simple_pbft_tpu.crypto.verifier import BatchItem
+
+        seed = b"\x77" * 32
+        walkin = BatchItem(
+            ref.public_key(seed), b"walk-in", ref.sign(seed, b"walk-in")
+        )
+        assert v.verify_batch([walkin]) == [True]
+        assert len(v._bank._index) == n_keys + 1  # registered in place
+        assert v._bank._cap == cap  # capacity (jit shape) unmoved
+
     def test_seed_files_hold_no_shared_secrets(self, tmp_path):
         deploy.generate(str(tmp_path), n=4, clients=1)
         doc = json.load(open(tmp_path / "committee.json"))
