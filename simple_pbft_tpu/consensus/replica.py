@@ -62,7 +62,7 @@ from ..messages import (
 )
 from ..transport.base import Transport
 from . import qc as qc_mod
-from .state import ExecuteBlock, Instance, SendCommit, SendPrepare
+from .state import ExecuteBlock, Instance, SendCommit, SendPrepare, Stage
 from .viewchange import (
     ViewChanger,
     validate_new_view,
@@ -332,7 +332,41 @@ class Replica:
         for key, req in sorted(self.relay_buffer.items()):
             if req.timestamp > self.client_watermark.get(req.client_id, 0):
                 self.pending_requests.append(req)
+                self.seen_requests[key] = 0  # now owned by our pipeline
         self.relay_buffer.clear()
+
+    async def rerelay_outstanding(self, new_view: int) -> None:
+        """A NEW-VIEW installed and we are NOT its primary: client work
+        stranded HERE must chase the new primary or it is lost to the
+        committee. Two pools strand (measured, qc-n64 chaos tail —
+        unanimous view, idle primary, 128 starving clients):
+        (1) pending_requests queued while WE were primary — a deposed
+        primary's backlog never feeds another replica's proposal;
+        (2) relay_buffer entries sent exactly once to a primary that
+        died with its view. Re-relay is capped per install; client
+        retries plus the primary's requeue path cover any overflow."""
+        for req in self.pending_requests:
+            k = (req.client_id, req.timestamp)
+            # -1 unconditionally: our pipeline no longer owns this key.
+            # Even when the relay buffer is at cap and the request is
+            # dropped outright, the -1 keeps the primary-side requeue
+            # path willing to re-adopt it from a client retry (0 would
+            # claim an ownership no pool backs).
+            self.seen_requests[k] = -1
+            if k not in self.relay_buffer and len(self.relay_buffer) < 65536:
+                self.relay_buffer[k] = req
+        self.pending_requests = []
+        primary = self.cfg.primary(new_view)
+        sent = 0
+        for key, req in sorted(self.relay_buffer.items()):
+            if req.timestamp <= self.client_watermark.get(req.client_id, 0):
+                continue
+            await self.transport.send(primary, req.to_wire())
+            sent += 1
+            if sent >= 512:
+                break
+        if sent:
+            self.metrics["requests_rerelayed"] += sent
 
     async def _ingest(self) -> None:
         """Stage 1 of the runtime pipeline: drain the transport, decode,
@@ -743,7 +777,41 @@ class Replica:
             elif key in self.relay_buffer or key in self.seen_requests:
                 # client is retrying something still unexecuted: the
                 # primary may be faulty — (re)arm the failover timer
+                self.metrics["request_retries_seen"] += 1
                 self.vc.arm()
+                if self.is_primary and not self.vc.in_view_change:
+                    # Retry landed at the CURRENT primary: dedup alone
+                    # would strand it (measured, qc-n64 chaos tail: a
+                    # unanimous post-failover committee, idle primary,
+                    # every client starving — the work was "seen" in a
+                    # dead view so nobody ever re-proposed it).
+                    s = self.seen_requests.get(key, 0)
+                    if key in self.relay_buffer or s == -1:
+                        # seen as a BACKUP (relayed to a primary that
+                        # died with its view): we own the slot now
+                        self.pending_requests.append(
+                            self.relay_buffer.pop(key, req)
+                        )
+                        self.seen_requests[key] = 0
+                        self.metrics["requests_requeued"] += 1
+                    elif s > 0 and (
+                        s <= self.executed_seq
+                        or (
+                            s not in self.ready
+                            and (self.view, s) not in self.instances
+                        )
+                    ):
+                        # Assigned to a slot that died with an old view
+                        # (only PRE_PREPARED there, so no prepared proof
+                        # reached the O-set) — or to a slot the O-set
+                        # NO-OP-REFILLED and already executed: this
+                        # branch only runs with no cached reply and
+                        # ts above the fold, so an executed slot that
+                        # produced no reply for this request provably
+                        # did not contain it. Requeue for this view.
+                        self.seen_requests[key] = 0
+                        self.pending_requests.append(req)
+                        self.metrics["requests_requeued"] += 1
             elif req.timestamp <= floor:
                 # below the fold with no cached reply and no in-flight
                 # trace: the reply was folded away (or the slot lost to
@@ -758,8 +826,11 @@ class Replica:
             self.vc.arm()
         else:
             # backup: relay to the primary (client may have broadcast after
-            # a timeout), remember it as failover evidence, arm the timer
-            self.seen_requests[key] = 0
+            # a timeout), remember it as failover evidence, arm the timer.
+            # -1 = relayed, NOT in our pending queue: if we later become
+            # primary, a client retry must requeue it (0 would claim the
+            # proposal pipeline already owns it)
+            self.seen_requests[key] = -1
             if len(self.relay_buffer) < 65536:  # bounded
                 self.relay_buffer[key] = req
             self.vc.arm()
@@ -1550,6 +1621,77 @@ class Replica:
             for s in range(self.executed_seq + 1, horizon + 1)
             if s not in self.ready
         ]
+
+    async def resend_frontier_votes(self, window: int = 4) -> None:
+        """Targeted VOTE retransmission for the stalled frontier.
+
+        Votes (QC mode: BLS shares) are emitted exactly once, on a phase
+        transition; a dropped vote frame is otherwise gone forever.
+        Slot probes cannot repair that — they fetch artifacts that
+        EXIST, and a commit QC missing five shares does not exist; the
+        missing senders must re-send. Measured failure (qc-n64, 2%
+        drop, seed 99): a unanimous, live committee with the frontier
+        slot PREPARED and its commit shares stuck at 38/43 for minutes —
+        progress only via the full view-change backoff ladder, which
+        outlasts client patience.
+
+        Fired from the probe chain while stalled. Idempotent: receivers
+        duplicate-drop by sender, and _send_vote's frozen gate keeps
+        resends silent during a view change. The primary leg re-attempts
+        aggregation for slots whose quorum-crossing share arrived before
+        this replica installed the view (the arrival-edge trigger is
+        gated on is_primary at arrival time, so such slots hold 2f+1
+        shares and no QC until someone re-asks)."""
+        v = self.view
+        base = self.executed_seq
+        for seq in range(base + 1, base + 1 + window):
+            inst = self.instances.get((v, seq))
+            if (
+                inst is None
+                or inst.digest is None
+                or inst.pre_prepare is None
+                or inst.stage == Stage.COMMITTED
+                or inst.commit_qc is not None
+            ):
+                continue
+            self.metrics["frontier_votes_resent"] += 1
+            await self._send_vote(
+                Prepare, "prepare", SendPrepare(v, seq, inst.digest)
+            )
+            if inst.stage == Stage.PREPARED or inst.prepare_qc is not None:
+                await self._send_vote(
+                    Commit, "commit", SendCommit(v, seq, inst.digest)
+                )
+        if self.is_primary:
+            for seq in range(base + 1, base + 1 + window):
+                inst = self.instances.get((v, seq))
+                if inst is None or inst.digest is None:
+                    continue
+                if (
+                    inst.stage == Stage.PRE_PREPARED
+                    and inst.prepare_qc is None
+                    and inst.pre_prepare is not None
+                    and len(inst.prepares) <= 1
+                ):
+                    # prepare phase visibly dead: the original broadcast
+                    # raced the backups' view install (frozen replicas
+                    # drop in-flight phase traffic) or was lost — and a
+                    # pre-prepare is otherwise sent exactly once.
+                    # Backups cannot probe for a slot they never heard
+                    # of; only this re-broadcast teaches them it exists.
+                    self.metrics["preprepares_rebroadcast"] += 1
+                    await self.transport.broadcast(
+                        inst.pre_prepare.to_wire(), self.cfg.replica_ids
+                    )
+                if not self.cfg.qc_mode:
+                    continue
+                if inst.prepare_qc is None:
+                    await self._try_aggregate(inst, "prepare")
+                if inst.commit_qc is None and (
+                    inst.prepare_qc is not None
+                    or inst.stage == Stage.PREPARED
+                ):
+                    await self._try_aggregate(inst, "commit")
 
     async def send_slot_probe(self) -> None:
         """Ask peers to re-send stalled slots' artifacts. Fired by the

@@ -392,8 +392,15 @@ class ViewChanger:
             self._armed_committed = self.r.max_committed_seen
             self._timer = loop.call_later(self._jitter(self._timeout), self._expired)
             if self._probe_timer is None:
+                # repair cadence is CAPPED, not tied to the backoff
+                # ladder: a backed-off failover timer (up to 60 s) must
+                # not stretch probe/vote-resend intervals to 30 s — the
+                # stall those repairs exist for is exactly when the
+                # ladder is high (seed-99 chaos tail: frontier commit
+                # shares stuck 38/43 while probes slept out the backoff)
                 self._probe_timer = loop.call_later(
-                    self._jitter(self._timeout / 2), self._probe
+                    self._jitter(min(max(0.5, self._timeout / 2), 3.0)),
+                    self._probe,
                 )
 
     def reset(self) -> None:
@@ -425,8 +432,11 @@ class ViewChanger:
         relays no client work never arms it, yet can still lose frames —
         and arming failover on local holes causes join cascades)."""
         if self._probe_timer is None and self.r.cfg.view_timeout > 0:
+            # same cadence cap as arm()/_probe: the first repair probe
+            # must not sleep out a backed-off failover ladder
             self._probe_timer = asyncio.get_running_loop().call_later(
-                self._jitter(max(0.25, self._timeout / 4)), self._probe
+                self._jitter(min(max(0.25, self._timeout / 4), 3.0)),
+                self._probe,
             )
 
     def _spawn(self, coro) -> None:
@@ -462,10 +472,17 @@ class ViewChanger:
             return
         # retain the task (a bare ensure_future can be collected mid-send)
         self._spawn(self.r.send_slot_probe())
+        # vote retransmission rides the same stall signal: probes fetch
+        # artifacts that exist; lost VOTES for the frontier must be
+        # re-emitted by their senders or the slot stalls until the
+        # view-change ladder outlasts client patience (qc-n64 chaos
+        # tail starvation, seed 99)
+        self._spawn(self.r.resend_frontier_votes())
         # keep probing while the stall lasts (the response itself can be
-        # dropped); the server side rate-limits per sender
+        # dropped); the server side rate-limits per sender. Cadence is
+        # capped independently of the failover backoff (see arm()).
         self._probe_timer = asyncio.get_running_loop().call_later(
-            self._jitter(max(0.5, self._timeout / 2)), self._probe
+            self._jitter(min(max(0.5, self._timeout / 2), 3.0)), self._probe
         )
 
     def _expired(self) -> None:
@@ -976,4 +993,10 @@ class ViewChanger:
         if r.cfg.primary(new_view) == r.id:
             r.next_seq = max_seq + 1
             r.adopt_relayed_requests()
+        else:
+            # stranded client work (a deposed primary's backlog, relays
+            # aimed at dead primaries) must chase the NEW primary — the
+            # O-set only re-issues PREPARED work, so anything less
+            # travelled relies on exactly this hand-off
+            await r.rerelay_outstanding(new_view)
         await r.propose_if_ready()
