@@ -1644,6 +1644,15 @@ class Replica:
         shares and no QC until someone re-asks)."""
         v = self.view
         base = self.executed_seq
+        now = time.perf_counter()
+        # Small age floor only — the STALL decision lives at the caller
+        # (ViewChanger._probe fires this solely when execution made no
+        # progress between probe ticks). A hard 3 s per-instance age gate
+        # was tried instead and re-starved the chaos tail (repairs came
+        # too late); resending mid-flight slots on every tick was also
+        # tried and taxed CLEAN qc-n64 throughput ~12%. Progress-gating
+        # gets both: zero traffic while healthy, fast repair when stuck.
+        stall_age = 1.0
         for seq in range(base + 1, base + 1 + window):
             inst = self.instances.get((v, seq))
             if (
@@ -1652,6 +1661,7 @@ class Replica:
                 or inst.pre_prepare is None
                 or inst.stage == Stage.COMMITTED
                 or inst.commit_qc is not None
+                or now - inst.t_started < stall_age
             ):
                 continue
             self.metrics["frontier_votes_resent"] += 1
@@ -1665,7 +1675,11 @@ class Replica:
         if self.is_primary:
             for seq in range(base + 1, base + 1 + window):
                 inst = self.instances.get((v, seq))
-                if inst is None or inst.digest is None:
+                if (
+                    inst is None
+                    or inst.digest is None
+                    or now - inst.t_started < stall_age
+                ):
                     continue
                 if (
                     inst.stage == Stage.PRE_PREPARED

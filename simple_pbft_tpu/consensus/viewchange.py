@@ -368,6 +368,9 @@ class ViewChanger:
         self._armed_exec = -1
         self._armed_committed = -1
         self._deferred_key = None
+        # executed_seq at the previous probe tick: vote retransmission
+        # fires only when two consecutive ticks see no progress
+        self._probe_last_exec = -1
         self._target_expiries = 0  # expiries while frozen at one target
         self._last_target_support = -1  # store size at the last expiry
         # highest view seen in signature-verified traffic (bounded by
@@ -469,15 +472,22 @@ class ViewChanger:
             or self.r.ready
             or self.pending_view_hint()
         ):
+            # chain going idle: invalidate the progress marker so the
+            # next chain's FIRST tick can never match a stale value and
+            # fire vote resends on a healthy pipeline
+            self._probe_last_exec = -1
             return
         # retain the task (a bare ensure_future can be collected mid-send)
         self._spawn(self.r.send_slot_probe())
-        # vote retransmission rides the same stall signal: probes fetch
-        # artifacts that exist; lost VOTES for the frontier must be
-        # re-emitted by their senders or the slot stalls until the
-        # view-change ladder outlasts client patience (qc-n64 chaos
-        # tail starvation, seed 99)
-        self._spawn(self.r.resend_frontier_votes())
+        # vote retransmission fires only when execution made NO progress
+        # since the last probe tick: probes fetch artifacts that exist;
+        # lost VOTES for the frontier must be re-emitted by their senders
+        # or the slot stalls until the view-change ladder outlasts client
+        # patience (qc-n64 chaos tail starvation, seed 99). The progress
+        # gate keeps healthy pipelines free of redundant vote traffic.
+        if self.r.executed_seq == self._probe_last_exec:
+            self._spawn(self.r.resend_frontier_votes())
+        self._probe_last_exec = self.r.executed_seq
         # keep probing while the stall lasts (the response itself can be
         # dropped); the server side rate-limits per sender. Cadence is
         # capped independently of the failover backoff (see arm()).
