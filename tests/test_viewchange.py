@@ -80,10 +80,14 @@ def test_failover_after_stable_checkpoint():
     checkpoint certificate AT h (GC once deleted it, wedging failover)."""
 
     async def main():
-        c = LocalCommittee.build(n=4, view_timeout=0.3, checkpoint_interval=2)
+        # 0.8 s timer / 0.5 s client: the assertion is BEHAVIORAL (the
+        # post-checkpoint VIEW-CHANGE certificate works) — at 0.3/0.25 s
+        # a saturated full-suite host stalls the loop past whole timer
+        # periods and fails the submit patience spuriously
+        c = LocalCommittee.build(n=4, view_timeout=0.8, checkpoint_interval=2)
         c.start()
         client = c.clients[0]
-        client.request_timeout = 0.25
+        client.request_timeout = 0.5
         for i in range(4):  # past two checkpoint intervals
             assert await client.submit(f"put k{i} {i}") == "ok"
         assert all(r.stable_seq > 0 for r in c.replicas)
