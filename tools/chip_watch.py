@@ -36,8 +36,13 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
-OUT = os.path.join(REPO, "bench_results", "chip_r04.jsonl")
-PROFILE_DIR = os.path.join(REPO, "bench_results", "profile_r04")
+# round-scoped artifacts: override WATCH_ROUND for later rounds (the
+# results file doubles as the watcher's resume state, so each round gets
+# a fresh experiment ledger while bench.py's prior-evidence fallback
+# globs chip_r*.jsonl across all of them)
+ROUND = os.environ.get("WATCH_ROUND", "r04")
+OUT = os.path.join(REPO, "bench_results", f"chip_{ROUND}.jsonl")
+PROFILE_DIR = os.path.join(REPO, "bench_results", f"profile_{ROUND}")
 PROBE_TIMEOUT = float(os.environ.get("WATCH_PROBE_TIMEOUT", "45"))
 DOWN_SLEEP = float(os.environ.get("WATCH_DOWN_SLEEP", "240"))
 MAX_ATTEMPTS = 3
