@@ -924,6 +924,14 @@ class Replica:
             actions = inst.on_pre_prepare(msg)
             if inst.pre_prepare is not None and inst.t_started == 0.0:
                 inst.t_started = time.perf_counter()  # commit-latency clock
+                # An admitted proposal IS pending client work (the paper
+                # arms backup view timers exactly here): without this, a
+                # backup that never saw the request itself has no armed
+                # failover timer AND no probe chain — so a lost vote for
+                # this slot goes unrepaired until a client retry happens
+                # to arrive and arm it (measured: vote-loss recovery
+                # latency equaled client patience, not probe cadence)
+                self.vc.arm()
             if inst.pre_prepare is msg:
                 # admitted (digest verified by the instance): remember the
                 # block so digest-only certificates can be refilled later,

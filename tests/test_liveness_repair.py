@@ -1,0 +1,198 @@
+"""Directed regressions for the round-4 targeted-repair mechanisms
+(docs/PROTOCOL.md "Targeted repair under message loss").
+
+Each test pins one of the liveness holes found in the qc-n64 chaos
+tail post-mortem (a unanimous live committee, idle primary, starving
+clients) with a DETERMINISTIC small-scale reproduction — the seeded
+chaos A/Bs in bench_results/ prove the composite; these prove each
+mechanism in isolation so a regression names its culprit.
+
+The reference has no failure handling at all (stage gates wait forever,
+`需要改进的地方.md:26-29`; dead view change, view.go) — this entire
+surface is rebuild-only.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from simple_pbft_tpu.committee import LocalCommittee
+from simple_pbft_tpu.messages import Commit, Message, Prepare
+from simple_pbft_tpu.transport.local import FaultPlan
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def _drop_first_votes(replica, kinds, count):
+    """Wrap `replica`'s outbound send AND broadcast: silently eat the
+    first `count` emissions of the given kinds (the vote frames a lossy
+    link would lose), then pass everything — including RESENDS of the
+    same votes. QC mode votes are unicast (shares to the primary);
+    normal mode votes are broadcast."""
+    real_send = replica.transport.send
+    real_broadcast = replica.transport.broadcast
+    state = {"left": count, "eaten": 0}
+
+    def _eats(wire) -> bool:
+        if state["left"] <= 0:
+            return False
+        try:
+            msg = Message.from_wire(wire)
+        except ValueError:
+            return False
+        if isinstance(msg, kinds):
+            state["left"] -= 1
+            state["eaten"] += 1
+            return True
+        return False
+
+    async def send(target, wire):
+        if not _eats(wire):
+            await real_send(target, wire)
+
+    async def broadcast(wire, dests):
+        if not _eats(wire):
+            await real_broadcast(wire, dests)
+
+    replica.transport.send = send
+    replica.transport.broadcast = broadcast
+    return state
+
+
+def test_lost_commit_shares_repaired_without_view_change():
+    """QC mode: eat the FIRST commit share from two backups (quorum now
+    unreachable from first sends alone). The frontier stalls, the probe
+    chain notices zero progress between ticks, the senders re-emit their
+    shares, the primary aggregates — all in view 0. Before round 4 this
+    slot stalled until the failover ladder outran client patience."""
+
+    async def scenario():
+        com = LocalCommittee.build(
+            n=4, clients=1, qc_mode=True,
+            # failover timer far beyond the test: recovery must come
+            # from vote retransmission, not a view change
+            view_timeout=60.0,
+        )
+        com.start()
+        c = com.clients[0]
+        # the client must never get to retry: success before the first
+        # client timeout proves the PROBE-cadence resend did the repair
+        # (admitted pre-prepares arm the chain; ~2 ticks at <=3 s each)
+        c.request_timeout = 30.0
+        eaten = [
+            _drop_first_votes(com.replica(r), (Commit,), 1)
+            for r in ("r1", "r2")
+        ]
+        t0 = time.perf_counter()
+        assert await c.submit("put k 1") == "ok"
+        assert time.perf_counter() - t0 < 25.0, "repair waited on client patience"
+        assert all(s["eaten"] == 1 for s in eaten), eaten
+        assert all(r.view == 0 for r in com.replicas)
+        resent = sum(
+            r.metrics.get("frontier_votes_resent", 0) for r in com.replicas
+        )
+        assert resent > 0, "repair must be the resend path, not luck"
+        await com.stop()
+
+    run(scenario())
+
+
+def test_lost_prepare_votes_repaired_without_view_change():
+    """Normal (broadcast-vote) mode: eat the first prepare AND commit
+    from two backups toward everyone — with n=4 the 2f+1=3 quorums then
+    need the resend path at every replica."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1, view_timeout=60.0)
+        com.start()
+        c = com.clients[0]
+        c.request_timeout = 30.0
+        # eat each backup's first prepare broadcast and first commit
+        # broadcast: 2f+1=3 quorums then need the resend path
+        eaten = [
+            _drop_first_votes(com.replica(r), (Prepare, Commit), 2)
+            for r in ("r1", "r2")
+        ]
+        t0 = time.perf_counter()
+        assert await c.submit("put k 2") == "ok"
+        assert time.perf_counter() - t0 < 25.0, "repair waited on client patience"
+        assert all(s["eaten"] == 2 for s in eaten), eaten
+        assert all(r.view == 0 for r in com.replicas)
+        await com.stop()
+
+    run(scenario())
+
+
+def test_stranded_request_rescued_across_failover():
+    """Client work must survive a failover that kills the only primary
+    that ever saw it as primary: the request is queued at r0 (isolated
+    before proposing), the committee moves to view 1, and the backups'
+    install-time re-relay plus the new primary's requeue path must get
+    it committed — the O-set cannot carry it (it was never prepared)."""
+
+    async def scenario():
+        plan = FaultPlan(seed=3)
+        com = LocalCommittee.build(
+            n=4, clients=1, fault_plan=plan, view_timeout=1.5,
+        )
+        com.start()
+        c = com.clients[0]
+        c.request_timeout = 2.0
+        # cut r0 off from the committee BUT not from the client: the
+        # request reaches r0 (it queues it as primary) and reaches the
+        # backups only as the client's retry broadcasts
+        for other in ("r1", "r2", "r3"):
+            plan.cut("r0", other)
+        assert await c.submit("put stranded 7", retries=30) == "ok"
+        survivors = [r for r in com.replicas if r.id != "r0"]
+        assert all(r.view >= 1 for r in survivors)
+        # submit resolves at f+1 matching replies; the slowest survivor
+        # may still be executing — settle before the all-survivors check
+        for _ in range(80):
+            if all(r.app.data.get("stranded") == "7" for r in survivors):
+                break
+            await asyncio.sleep(0.25)
+        assert all(r.app.data.get("stranded") == "7" for r in survivors)
+        await com.stop()
+
+    run(scenario())
+
+
+def test_new_primary_requeues_retry_for_dead_slot():
+    """The dedup-eats-retries hole: work assigned to a slot that died
+    with an old view must be re-queued when the client's retry reaches
+    the new primary, not swallowed by seen_requests."""
+
+    async def scenario():
+        plan = FaultPlan(seed=5)
+        com = LocalCommittee.build(
+            n=4, clients=1, fault_plan=plan, view_timeout=1.5,
+        )
+        com.start()
+        c = com.clients[0]
+        c.request_timeout = 2.0
+        assert await c.submit("put warm 0") == "ok"  # healthy baseline
+        # isolate r0 (view 0's primary) completely mid-reign; the next
+        # request strands wherever it was first seen until failover
+        for other in ("r1", "r2", "r3", c.id):
+            plan.cut("r0", other)
+        assert await c.submit("put rescued 9", retries=30) == "ok"
+        survivors = [r for r in com.replicas if r.id != "r0"]
+        assert all(r.view >= 1 for r in survivors)
+        for _ in range(80):
+            if all(r.app.data.get("rescued") == "9" for r in survivors):
+                break
+            await asyncio.sleep(0.25)
+        assert all(r.app.data.get("rescued") == "9" for r in survivors)
+        await com.stop()
+
+    run(scenario())
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

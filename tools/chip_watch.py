@@ -41,6 +41,11 @@ sys.path.insert(0, REPO)
 # a fresh experiment ledger while bench.py's prior-evidence fallback
 # globs chip_r*.jsonl across all of them)
 ROUND = os.environ.get("WATCH_ROUND", "r04")
+if not __import__("re").fullmatch(r"r\d+", ROUND):
+    # the prior-evidence fallback in bench.py globs chip_r*.jsonl — a
+    # free-form round tag would write a ledger it silently never finds
+    # (and a path-separator value would escape bench_results/)
+    raise SystemExit(f"WATCH_ROUND must match r<digits>, got {ROUND!r}")
 OUT = os.path.join(REPO, "bench_results", f"chip_{ROUND}.jsonl")
 PROFILE_DIR = os.path.join(REPO, "bench_results", f"profile_{ROUND}")
 PROBE_TIMEOUT = float(os.environ.get("WATCH_PROBE_TIMEOUT", "45"))
