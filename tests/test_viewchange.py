@@ -95,7 +95,12 @@ def test_failover_after_stable_checkpoint():
         assert await client.submit("put after 1", retries=60) == "ok"
         survivors = [r for r in c.replicas if r.id != "r0"]
         assert all(r.view >= 1 for r in survivors)
-        assert all(r.app.data.get("after") == "1" for r in survivors)
+        # settle: submit resolves at f+1 replies, the third survivor
+        # may still be executing under a loaded host
+        assert await _eventually(
+            lambda: all(r.app.data.get("after") == "1" for r in survivors),
+            timeout=15, tick=0.25,
+        )
         await c.stop()
 
     _run(main())
@@ -149,7 +154,12 @@ def test_prepared_request_survives_view_change():
         result = await client.submit("put y 7", retries=30)
         assert result == "ok"
         survivors = [c.replica(r) for r in ("r1", "r2", "r3")]
-        assert all(r.app.data.get("y") == "7" for r in survivors)
+        # submit resolves at f+1 matching replies — settle so the
+        # slowest survivor's execution doesn't race the assertion
+        assert await _eventually(
+            lambda: all(r.app.data.get("y") == "7" for r in survivors),
+            timeout=15, tick=0.25,
+        )
         snaps = {r.app.snapshot() for r in survivors}
         assert len(snaps) == 1  # no divergence
         await c.stop()
