@@ -433,6 +433,68 @@ def _worker_main() -> None:
 
 # --- orchestrator (no jax imports in this section) -----------------------
 
+DAEMON_PORT = int(os.environ.get("CHIP_DAEMON_PORT", "48765"))
+
+
+def _daemon_request(req: dict, timeout: float) -> dict | None:
+    """One JSON-line round trip to the chip daemon (tools/chip_daemon.py).
+    None = no daemon listening / bad reply — the caller falls back to
+    probing the tunnel itself."""
+    import socket
+
+    try:
+        with socket.create_connection(("127.0.0.1", DAEMON_PORT), timeout=5.0) as s:
+            s.settimeout(timeout)
+            s.sendall((json.dumps(req) + "\n").encode())
+            buf = b""
+            while b"\n" not in buf and len(buf) < 1 << 20:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        return json.loads(buf.split(b"\n", 1)[0].decode())
+    except (OSError, ValueError):
+        return None
+
+
+def _try_daemon(deadline: float) -> dict | None:
+    """Ask the persistent chip daemon for a LIVE measurement (VERDICT r4
+    next #3: the device tunnel is effectively single-tenant, so while
+    the watcher family holds it, this process's own attach would hang —
+    four rounds of driver-slot probes died exactly that way). Polls
+    until the daemon frees the device or the budget is nearly spent.
+    Returns the measurement record, or None to fall back to probing."""
+    first = _daemon_request({"cmd": "status"}, timeout=15.0)
+    if first is None:
+        print("no chip daemon listening; falling back to probes", file=sys.stderr)
+        return None
+    print(f"chip daemon status: {first}", file=sys.stderr)
+    attempt = 0
+    while True:
+        remaining = deadline - time.time()
+        if remaining < 90:
+            return None
+        attempt += 1
+        _best["note"] = f"asking chip daemon (attempt {attempt})"
+        # wait_s bounds how long the daemon holds our request while an
+        # experiment owns the device; keep polls short enough to retry
+        rec = _daemon_request(
+            {"cmd": "measure", "min_s": 2.0, "wait_s": min(60.0, remaining - 75)},
+            timeout=min(300.0, remaining - 60),
+        )
+        if rec is None:
+            return None
+        if (
+            rec.get("ok")
+            and rec.get("value", 0) > 0
+            and rec.get("platform") not in (None, "cpu")
+        ):
+            rec["source"] = "chip_daemon"
+            return rec
+        why = rec.get("why") or ("busy: " + str(rec.get("current_exp")))
+        print(f"daemon measure attempt {attempt}: {why}", file=sys.stderr)
+        time.sleep(min(20.0, max(0.0, deadline - time.time() - 90)))
+
 _PROBE_SRC = r"""
 import json, time
 t0 = time.time()
@@ -518,6 +580,28 @@ def main() -> None:
     retry_sleep = float(os.environ.get("BENCH_PROBE_RETRY_SLEEP", "20"))
     probes: list[dict] = []
     last_worker_err = None
+    # 1) daemon-first: a live measurement through the persistent worker
+    #    costs seconds and never competes for the single-tenant tunnel
+    rec = _try_daemon(deadline)
+    if rec is not None:
+        rec = {
+            "metric": "ed25519_verifies_per_sec_per_chip",
+            "value": round(rec["value"], 1),
+            "unit": "verifies/s",
+            "vs_baseline": round(rec["value"] / 1_000_000, 4),
+            **{
+                k: rec[k]
+                for k in (
+                    "batch", "window", "mode", "platform", "measured_at",
+                    "live", "source", "compile_s", "attach_s",
+                )
+                if k in rec
+            },
+        }
+        _best_rec = rec
+        _emit()
+        return
+    # 2) legacy path: probe + attach ourselves
     while True:
         remaining = deadline - time.time()
         if remaining < 75:

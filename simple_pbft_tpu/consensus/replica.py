@@ -455,19 +455,28 @@ class Replica:
                 items.extend(self._batch_items(msg))
                 spans.append((start, len(items)))
             if items:
-                verify_task = asyncio.get_running_loop().create_task(
-                    asyncio.to_thread(self._timed_verify, items)
-                )
+                if hasattr(self.verifier, "submit"):
+                    # coalescing service (crypto/coalesce.py): await the
+                    # future directly — no executor thread parks on the
+                    # device RTT, so EVERY replica in the process can
+                    # have a sweep in flight at once and the service
+                    # folds them into one device pass (the default
+                    # thread pool's ~5 workers were a hidden cap on how
+                    # many replicas' sweeps could even be pending)
+                    verify_task = asyncio.get_running_loop().create_task(
+                        self._submit_verify(items)
+                    )
+                else:
+                    verify_task = asyncio.get_running_loop().create_task(
+                        asyncio.to_thread(self._timed_verify, items)
+                    )
             self.metrics["verified_sigs"] += len(items)
         return decoded, spans, verify_task
 
-    def _timed_verify(self, items: List[BatchItem]) -> List[bool]:
-        """Worker-thread wrapper: one verifier call, instrumented so
-        verifies/s and per-batch latency are observable (VERDICT weak #8).
-        Already-verified signatures answer from the per-replica cache
-        (locked: the pipeline overlaps consecutive sweeps' verifies in
-        separate executor threads)."""
-        t0 = time.perf_counter()
+    def _cache_filter(self, items: List[BatchItem]):
+        """Split a sweep's items into cache hits (already-verified-good)
+        and fresh work. Returns (out bitmap with hits set, fresh items,
+        their (position, cache-key) pairs)."""
         out = [False] * len(items)
         cache = self._sig_cache
         fresh: List[BatchItem] = []
@@ -484,24 +493,63 @@ class Replica:
                 else:
                     fresh.append(it)
                     fresh_keys.append((i, key))
+        return out, fresh, fresh_keys
+
+    def _cache_store(self, fresh_keys, verdicts, out: List[bool]) -> None:
+        """Fold fresh verdicts into the bitmap and the positive cache."""
+        cache = self._sig_cache
+        with self._sig_cache_lock:
+            for (i, key), ok in zip(fresh_keys, verdicts):
+                out[i] = bool(ok)
+                if ok:
+                    cache[key] = None
+            while len(cache) > self.SIG_CACHE_MAX:
+                cache.popitem(last=False)
+
+    def _record_verify(self, n_fresh: int, dt: float) -> None:
+        # cache-hit-only sweeps never reach the device; recording
+        # their ~0 ms samples would dilute verify batch-size and
+        # latency stats toward zero
+        if n_fresh:
+            self.stats.verify_ms.record(dt * 1e3)
+            self.stats.verify_items += n_fresh
+            self.stats.verify_seconds += dt
+
+    def _timed_verify(self, items: List[BatchItem]) -> List[bool]:
+        """Worker-thread wrapper: one verifier call, instrumented so
+        verifies/s and per-batch latency are observable (VERDICT weak #8).
+        Already-verified signatures answer from the per-replica cache
+        (locked: the pipeline overlaps consecutive sweeps' verifies in
+        separate executor threads)."""
+        t0 = time.perf_counter()
+        out, fresh, fresh_keys = self._cache_filter(items)
         if fresh:
             verdicts = self.verifier.verify_batch(fresh)
-            with self._sig_cache_lock:
-                for (i, key), ok in zip(fresh_keys, verdicts):
-                    out[i] = bool(ok)
-                    if ok:
-                        cache[key] = None
-                while len(cache) > self.SIG_CACHE_MAX:
-                    cache.popitem(last=False)
+            self._cache_store(fresh_keys, verdicts, out)
         self.metrics["sig_cache_hits"] += len(items) - len(fresh)
+        self._record_verify(len(fresh), time.perf_counter() - t0)
+        return out
+
+    async def _submit_verify(self, items: List[BatchItem]) -> List[bool]:
+        """Coalescing-service path: submit the fresh work and await the
+        future — the event loop stays free, and concurrent replicas'
+        sweeps ride the same device pass (crypto/coalesce.py)."""
+        t0 = time.perf_counter()
+        if len(items) > 256:
+            # the filter hashes every item (sha256 cache keys) — a full
+            # 4096-item sweep is multiple ms, too long to hold the loop
+            # that every replica in the process shares; small sweeps stay
+            # inline (a thread handoff costs more than the hashing)
+            out, fresh, fresh_keys = await asyncio.to_thread(
+                self._cache_filter, items
+            )
+        else:
+            out, fresh, fresh_keys = self._cache_filter(items)
         if fresh:
-            # cache-hit-only sweeps never reach the device; recording
-            # their ~0 ms samples would dilute verify batch-size and
-            # latency stats toward zero
-            dt = time.perf_counter() - t0
-            self.stats.verify_ms.record(dt * 1e3)
-            self.stats.verify_items += len(fresh)
-            self.stats.verify_seconds += dt
+            verdicts = await asyncio.wrap_future(self.verifier.submit(fresh))
+            self._cache_store(fresh_keys, verdicts, out)
+        self.metrics["sig_cache_hits"] += len(items) - len(fresh)
+        self._record_verify(len(fresh), time.perf_counter() - t0)
         return out
 
     async def _finish_sweep(self, decoded, spans, verify_task) -> None:

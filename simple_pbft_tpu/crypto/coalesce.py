@@ -1,0 +1,311 @@
+"""Process-wide coalescing verify service: many replicas, one device pass.
+
+Round-4 evidence (bench_results/chip_r04.jsonl) falsified the naive
+architecture: with every replica's drain sweep making its own blocking
+device call under the process-wide device lock, an n-replica committee
+pays n tunnel round trips per round of votes — n=16 consensus committed
+6.4 req/s with the chip in the loop vs 422 req/s with the CPU verifier.
+The device batch is shape-padded anyway, so one pass over EVERYONE's
+pending items costs the same wall clock as one replica's.
+
+This service is the fix (VERDICT r4 next #1). Replicas submit their
+sweeps' signature batches and get a `concurrent.futures.Future`; a
+single dispatcher thread coalesces everything pending into one batch
+and routes it:
+
+- small piles take the CPU path (native batched Ed25519) — idle traffic
+  never pays a device round trip; the cutoff adapts to the measured
+  device latency and CPU rate;
+- big piles are host-prepped and dispatched to the device WITHOUT
+  blocking (TpuVerifier.dispatch_batch): while batch k executes on the
+  chip, the dispatcher preps and dispatches batch k+1 (bounded depth),
+  and a completion thread resolves futures in dispatch order.
+
+The event loop never blocks and never burns an executor thread waiting:
+Replica._start_sweep awaits `asyncio.wrap_future(service.submit(...))`.
+
+The reference's quorum predicates — where these verifies would sit had
+it had signatures — are pbft/consensus/pbft_impl.go:207-232; its pools
+drain at pbft/network/node.go:393-420. One shared device standing in
+for every replica's crypto is exactly the TPU-first reading of that
+design: the chip is a committee-wide resource, like the network.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import List, Optional, Sequence
+
+from .verifier import BatchItem, Verifier, best_cpu_verifier
+
+
+class VerifyService:
+    """Coalescing front for a device verifier + CPU small-batch path.
+
+    Thread-safe; `submit` may be called from any thread (including the
+    event loop — it never blocks). `verify_batch` is the synchronous
+    Verifier-protocol view (submit + wait), so the service drops into
+    any seam a plain verifier fits.
+    """
+
+    name = "tpu-coalesced"
+
+    # dispatch policy knobs (see _take_locked): a second in-flight device
+    # call is only worth its dispatch overhead when the pending pile is
+    # already substantial; below that, waiting for the in-flight call to
+    # land coalesces harder for free.
+    MIN_SECOND_DISPATCH = 256
+    MAX_DEPTH = 2
+
+    def __init__(
+        self,
+        device,
+        cpu: Optional[Verifier] = None,
+        max_batch: int = 8192,
+        cpu_cutoff: Optional[int] = None,
+    ):
+        # public: callers (benches, deployment tests) reach through to
+        # the device verifier's bank/counters for contract checks
+        self.device = self._device = device
+        self._cpu = cpu if cpu is not None else best_cpu_verifier()
+        self._max_batch = max_batch
+        # fixed cutoff if given; else adaptive from the measured rates
+        self._fixed_cutoff = cpu_cutoff
+        self._pending: deque = deque()  # (items, future)
+        self._pending_items = 0
+        self._cond = threading.Condition()
+        self._inflight = 0
+        self._closed = False
+        self._started = False
+        # completion queue: (finisher, subs, t_dispatch, n_items)
+        self._done_q: deque = deque()
+        self._done_cond = threading.Condition()
+        # adaptive estimates, EMA-smoothed. Seeds are deliberately mid-
+        # range: a tunneled chip measures ~20-100 ms dispatch->result,
+        # a co-located one ~1-5 ms; the native CPU path ~20-40k items/s
+        # per core. Both converge within a few calls either way.
+        self._rtt_ema = 0.030
+        self._cpu_rate_ema = 25000.0
+        # observability (read by bench_consensus / ReplicaStats dumps)
+        self.device_passes = 0
+        self.device_pass_items = 0
+        self.cpu_passes = 0
+        self.cpu_pass_items = 0
+        self.max_coalesced = 0
+        self.coalesced_submissions = 0
+
+    @property
+    def rtt_ms(self) -> float:
+        """Smoothed dispatch->result latency of a device pass, ms (the
+        public face of the adaptive estimate the cutoff policy uses)."""
+        return self._rtt_ema * 1e3
+
+    # -- Verifier-protocol pass-throughs ---------------------------------
+
+    @property
+    def device_calls(self):
+        return self._device.device_calls
+
+    @device_calls.setter
+    def device_calls(self, v):
+        self._device.device_calls = v
+
+    @property
+    def device_items(self):
+        return self._device.device_items
+
+    @device_items.setter
+    def device_items(self, v):
+        self._device.device_items = v
+
+    @property
+    def device_seconds(self):
+        return self._device.device_seconds
+
+    @device_seconds.setter
+    def device_seconds(self, v):
+        self._device.device_seconds = v
+
+    def warm_for_population(self, pubkeys: Sequence[bytes], max_sweep: int) -> None:
+        self._device.warm_for_population(pubkeys, max_sweep)
+
+    def warm(self, **kw) -> None:
+        self._device.warm(**kw)
+
+    # -- submission API ---------------------------------------------------
+
+    def submit(self, items: Sequence[BatchItem]) -> "Future[List[bool]]":
+        """Enqueue a batch; the future resolves to its verdict bitmap.
+        Never blocks. Order within a submission is preserved."""
+        fut: Future = Future()
+        if not items:
+            fut.set_result([])
+            return fut
+        with self._cond:
+            closed = self._closed
+            if not closed:
+                if not self._started:
+                    self._start_threads()
+                self._pending.append((list(items), fut))
+                self._pending_items += len(items)
+                self._cond.notify_all()
+        if closed:
+            # teardown race (a replica's last sweep vs the bench closing
+            # the service): answer on the CPU path rather than erroring a
+            # sweep that already entered the pipeline — outside the lock,
+            # so a late submitter never serializes others behind a full
+            # scalar Ed25519 pass
+            fut.set_result(self._cpu.verify_batch(list(items)))
+        return fut
+
+    def verify_batch(self, items: Sequence[BatchItem]) -> List[bool]:
+        return self.submit(items).result()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        with self._done_cond:
+            self._done_cond.notify_all()
+
+    # -- internals ---------------------------------------------------------
+
+    def _start_threads(self) -> None:
+        self._started = True
+        threading.Thread(
+            target=self._dispatch_loop, name="verify-dispatch", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._complete_loop, name="verify-complete", daemon=True
+        ).start()
+
+    def _cutoff(self) -> int:
+        """Largest batch the CPU path should take: the point where CPU
+        time ≈ half a device round trip. Clamped so a glitchy RTT sample
+        can neither starve the device nor flood the core."""
+        if self._fixed_cutoff is not None:
+            return self._fixed_cutoff
+        c = int(self._cpu_rate_ema * self._rtt_ema * 0.5)
+        return max(16, min(c, 2048))
+
+    def _take_locked(self) -> "tuple[list, int]":
+        """Pop whole submissions up to max_batch items (caller holds the
+        lock). A single oversized submission is taken alone —
+        dispatch_batch chunks it internally."""
+        subs = []
+        total = 0
+        while self._pending:
+            n = len(self._pending[0][0])
+            if subs and total + n > self._max_batch:
+                break
+            items, fut = self._pending.popleft()
+            subs.append((items, fut))
+            total += n
+            self._pending_items -= n
+            if total >= self._max_batch:
+                break
+        return subs, total
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._closed and (
+                    not self._pending
+                    or self._inflight >= self.MAX_DEPTH
+                    or (
+                        self._inflight > 0
+                        and self._pending_items < self.MIN_SECOND_DISPATCH
+                    )
+                ):
+                    self._cond.wait()
+                if self._closed and not self._pending:
+                    # FIFO shutdown: the sentinel reaches the completion
+                    # thread only after every dispatched finisher, so no
+                    # in-flight future is ever abandoned by close()
+                    with self._done_cond:
+                        self._done_q.append(None)
+                        self._done_cond.notify_all()
+                    return
+                subs, total = self._take_locked()
+                if not subs:
+                    continue
+                route_cpu = total <= self._cutoff() and self._inflight == 0
+                if not route_cpu:
+                    self._inflight += 1
+            batch: List[BatchItem] = []
+            for items, _fut in subs:
+                batch.extend(items)
+            self.coalesced_submissions += len(subs)
+            self.max_coalesced = max(self.max_coalesced, total)
+            if route_cpu:
+                self._run_cpu(batch, subs)
+            else:
+                t0 = time.perf_counter()
+                try:
+                    finisher = self._device.dispatch_batch(batch)
+                except BaseException as e:  # noqa: BLE001
+                    self._fail(subs, e)
+                    with self._cond:
+                        self._inflight -= 1
+                        self._cond.notify_all()
+                    continue
+                with self._done_cond:
+                    self._done_q.append((finisher, subs, t0, total))
+                    self._done_cond.notify_all()
+
+    def _complete_loop(self) -> None:
+        while True:
+            with self._done_cond:
+                while not self._done_q:
+                    self._done_cond.wait()
+                entry = self._done_q.popleft()
+                if entry is None:  # dispatcher's shutdown sentinel
+                    return
+                finisher, subs, t0, total = entry
+            try:
+                verdicts = finisher()
+            except BaseException as e:  # noqa: BLE001
+                self._fail(subs, e)
+            else:
+                rtt = time.perf_counter() - t0
+                self._rtt_ema = 0.8 * self._rtt_ema + 0.2 * rtt
+                self.device_passes += 1
+                self.device_pass_items += total
+                self._resolve(subs, verdicts)
+            with self._cond:
+                self._inflight -= 1
+                self._cond.notify_all()
+
+    def _run_cpu(self, batch: List[BatchItem], subs) -> None:
+        t0 = time.perf_counter()
+        try:
+            verdicts = self._cpu.verify_batch(batch)
+        except BaseException as e:  # noqa: BLE001
+            self._fail(subs, e)
+            return
+        dt = time.perf_counter() - t0
+        if dt > 1e-6:
+            self._cpu_rate_ema = (
+                0.8 * self._cpu_rate_ema + 0.2 * (len(batch) / dt)
+            )
+        self.cpu_passes += 1
+        self.cpu_pass_items += len(batch)
+        self._resolve(subs, verdicts)
+
+    @staticmethod
+    def _resolve(subs, verdicts: List[bool]) -> None:
+        off = 0
+        for items, fut in subs:
+            n = len(items)
+            if not fut.cancelled():
+                fut.set_result(verdicts[off : off + n])
+            off += n
+
+    @staticmethod
+    def _fail(subs, exc: BaseException) -> None:
+        for _items, fut in subs:
+            if not fut.cancelled():
+                fut.set_exception(exc)

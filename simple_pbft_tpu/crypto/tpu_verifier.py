@@ -700,17 +700,36 @@ class TpuVerifier:
             self.verify_batch([dummy] * b)
 
     def verify_batch(self, items: Sequence[BatchItem]) -> List[bool]:
+        return self.dispatch_batch(items)()
+
+    def dispatch_batch(self, items: Sequence[BatchItem]):
+        """Host-prep + ASYNC device dispatch; returns a zero-arg finisher
+        that blocks on the device result and maps verdicts back per item.
+
+        The device lock covers only tracing/enqueue — jax dispatch is
+        asynchronous, so the device executes this batch while the caller
+        preps and dispatches the next one (the coalescing service's
+        double-buffering; VERDICT r4 next #1). `verify_batch` is just
+        dispatch + immediate finish."""
         if not items:
-            return []
-        out: List[bool] = []
+            return lambda: []
+        finishers = []
         maxb = BUCKETS[-1]
         for start in range(0, len(items), maxb):
             chunk = items[start : start + maxb]
-            out.extend(self._verify_chunk(chunk))
-        return out
+            finishers.append(self._dispatch_chunk(chunk))
 
-    def _verify_chunk(self, items: Sequence[BatchItem]) -> List[bool]:
+        def finish() -> List[bool]:
+            out: List[bool] = []
+            for fin in finishers:
+                out.extend(fin())
+            return out
+
+        return finish
+
+    def _dispatch_chunk(self, items: Sequence[BatchItem]):
         size = _bucket_size(max(len(items), self._align))
+        fallback: List[int] = []
         if self._mode in ("comb", "fused"):
             if self._mode == "fused":
                 prep, fallback = prepare_wire_batch(items, self._bank)
@@ -725,23 +744,28 @@ class TpuVerifier:
                 tables = self._bank.device_tables()
                 b_table = comb.base_table_device()
                 args = (s_nib, k_nib, a_idx, tables, b_table, r_y, r_sign, precheck)
-            # np.array (copy): fallback rows below are written in place
-            with _DEVICE_LOCK:
-                t0 = time.perf_counter()
-                verdict = np.array(self._fn(*args))
-                self.device_seconds += time.perf_counter() - t0
-                self.device_calls += 1
-                self.device_items += len(items)
-            if fallback:  # keys over the bank cap: CPU path
-                for i in fallback:
-                    it = items[i]
-                    verdict[i] = ref.verify(it.pubkey, it.msg, it.sig)
         else:
             prep = prepare_batch(items).padded(size)
+            args = prep.arrays()
+        with _DEVICE_LOCK:
+            t0 = time.perf_counter()
+            dev_out = self._fn(*args)  # async: enqueue only
+            self.device_calls += 1
+            self.device_items += len(items)
+
+        def finish() -> List[bool]:
+            # np.array (copy): fallback rows below are written in place
+            verdict = np.array(dev_out)  # blocks until the device answers
+            # dispatch->result wall time. Overlapped calls each count
+            # their full span, so the sum can exceed wall clock under
+            # pipelining — device_seconds is a latency integral, not an
+            # occupancy figure (verify_per_s_device derived from it is a
+            # LOWER bound on the device rate when calls overlap).
             with _DEVICE_LOCK:
-                t0 = time.perf_counter()
-                verdict = np.asarray(self._fn(*prep.arrays()))
                 self.device_seconds += time.perf_counter() - t0
-                self.device_calls += 1
-                self.device_items += len(items)
-        return verdict[: prep.n].tolist()
+            for i in fallback:  # keys over the bank cap: CPU path
+                it = items[i]
+                verdict[i] = ref.verify(it.pubkey, it.msg, it.sig)
+            return verdict[: prep.n].tolist()
+
+        return finish

@@ -38,19 +38,25 @@ def make_transport(name: str, node_id: str, dep: "deploy.Deployment"):
 
 def make_verifier(name: str, dep=None):
     if name == "tpu":
+        from .crypto.coalesce import VerifyService
         from .crypto.tpu_verifier import TpuVerifier
 
         if dep is None:
-            return TpuVerifier()
+            return VerifyService(TpuVerifier())
         # Size the key bank to the deployment's published key population
         # and pre-pay the device compiles before serving traffic: the
         # jit signature includes the table shape, so a bank growing
         # under live traffic means minutes-long compiles mid-consensus
         # (the round-4 consensus-on-chip zero-commit bug). max_sweep is
         # the replica's drain bound — every bucket a live sweep can hit
-        # is warmed at boot.
-        return TpuVerifier.for_population(
-            list(dep.cfg.pubkeys.values()), max_sweep=4096
+        # is warmed at boot. The VerifyService wrapper gives the node
+        # async non-blocking dispatch and a CPU path for tiny sweeps
+        # (one process = one replica here, so coalescing is across
+        # consecutive sweeps rather than replicas).
+        return VerifyService(
+            TpuVerifier.for_population(
+                list(dep.cfg.pubkeys.values()), max_sweep=4096
+            )
         )
     if name == "cpu":
         return best_cpu_verifier()

@@ -64,7 +64,11 @@ def _limbs_to_int_np(limbs: np.ndarray) -> int:
     """Host-side inverse (for tests/debug); limb axis leading."""
     v = 0
     for i in reversed(range(NLIMB)):
-        v = (v << RADIX) | int(limbs[i, ...])
+        # .item(): exact for scalars AND size-1 batch dims (a bare int()
+        # on an ndim>0 array is a numpy DeprecationWarning on its way to
+        # a TypeError), and loudly fails on a real batch instead of
+        # silently folding it
+        v = (v << RADIX) | int(np.asarray(limbs[i, ...]).item())
     return v
 
 

@@ -199,7 +199,10 @@ class TestDeployDocs:
                 self, pubkeys, (8,)
             ),
         ):
-            v = make_verifier("tpu", dep)
+            svc = make_verifier("tpu", dep)
+        # node.py wraps the device verifier in the coalescing service;
+        # the sizing/registration contract lives on the device verifier
+        v = svc.device
         n_keys = len(dep.cfg.pubkeys)
         assert len(v._bank._index) == n_keys  # all published keys cached
         cap = v._bank._cap
