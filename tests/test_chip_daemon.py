@@ -149,17 +149,15 @@ def test_bench_rejects_cpu_platform_daemon(daemon, monkeypatch):
 
 
 def test_queue_next_experiment_order(tmp_path, monkeypatch):
-    """The round-5 queue leads with the unfinished w6 A/B, then the
-    coalesced consensus ladder; attempts are bounded."""
+    """The round-5 queue leads with the thesis experiment (n=16
+    consensus on chip), then the w6 A/B; attempts are bounded."""
     monkeypatch.setattr(chip_daemon, "OUT", str(tmp_path / "q.jsonl"))
     results = []
     exp = chip_daemon.next_experiment(results)
-    assert exp["exp"] == "verify_w6"
-    results.append({"exp": "verify_w6", "ok": True, "rec": {"value": 1.0}})
-    assert chip_daemon.next_experiment(results)["exp"] == "verify_w5"
-    results.append({"exp": "verify_w5", "ok": True, "rec": {"value": 2.0}})
-    assert chip_daemon.next_experiment(results)["exp"] == "consensus_n16"
+    assert exp["exp"] == "consensus_n16"
+    results.append({"exp": "consensus_n16", "ok": True, "rec": {"value": 1.0}})
+    assert chip_daemon.next_experiment(results)["exp"] == "verify_w6"
     # failed attempts retry up to MAX_ATTEMPTS, then fall through
     for _ in range(chip_daemon.MAX_ATTEMPTS):
-        results.append({"exp": "consensus_n16", "ok": False})
-    assert chip_daemon.next_experiment(results)["exp"] == "consensus_n64"
+        results.append({"exp": "verify_w6", "ok": False})
+    assert chip_daemon.next_experiment(results)["exp"] == "verify_w5"

@@ -374,19 +374,21 @@ def next_experiment(results: list[dict]) -> dict | None:
     def ready(name: str) -> bool:
         return name not in done and _attempts(results, name) < MAX_ATTEMPTS
 
-    # 1. w6 A/B (43 vs 52 madds/item; device-side w5 is ~910k/s, so w6
-    #    is the plausible route over 1M)
-    if ready("verify_w6"):
-        return _bench_exp("verify_w6", {"BENCH_WINDOW": "6"}, timeout=2400.0)
-    # 2. w5 re-baseline under the round-5 code (dispatch split etc.)
-    if ready("verify_w5"):
-        return _bench_exp("verify_w5", {"BENCH_WINDOW": "5"})
-    # 3. coalesced-service consensus ladder
+    # 1. the thesis experiment (VERDICT next #1, the round's headline):
+    #    n=16 consensus with the coalescing TPU verify service — short,
+    #    so even a brief healthy window produces the highest-value line
     if ready("consensus_n16"):
         return _consensus_exp(
             "consensus_n16",
             ["--configs", "2", "--verifier", "tpu", "--seconds", "20"],
         )
+    # 2. w6 A/B (43 vs 52 madds/item; device-side w5 is ~910k/s, so w6
+    #    is the plausible route over 1M)
+    if ready("verify_w6"):
+        return _bench_exp("verify_w6", {"BENCH_WINDOW": "6"}, timeout=2400.0)
+    # 3. w5 re-baseline under the round-5 code (dispatch split etc.)
+    if ready("verify_w5"):
+        return _bench_exp("verify_w5", {"BENCH_WINDOW": "5"})
     if ready("consensus_n64"):
         return _consensus_exp(
             "consensus_n64",
