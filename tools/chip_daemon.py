@@ -9,9 +9,10 @@ rounds of BENCH_r*.json read 0.0 that way, while the watcher's own log
 shows 0.2 s attaches in its windows.
 
 So: stop re-attaching. This daemon (VERDICT r4 next #3)
-  1. runs the round-5 experiment queue (verify w6 A/B, coalesced-service
-     consensus configs 2/3/5 on chip) in subprocesses, appending results
-     to bench_results/chip_r05.jsonl — resume state is the results file;
+  1. runs the round-5 experiment queue (coalesced-service consensus
+     configs 2/3/5 on chip — n=16 first, the thesis line — then the
+     verify w6 A/B) in subprocesses, appending results to
+     bench_results/chip_r05.jsonl — resume state is the results file;
   2. keeps a PERSISTENT measurement worker attached to the device with
      staged arrays, so a fresh verifies/s measurement costs seconds, not
      an attach + compile;
@@ -364,11 +365,12 @@ def _attempts(results: list[dict], name: str) -> int:
 
 
 def next_experiment(results: list[dict]) -> dict | None:
-    """Round-5 queue. Order is the VERDICT's priority order: finish the
-    w6 A/B first (next #2 — unfinished experiments head the queue), then
-    the coalescing-service consensus ladder (next #1: n=16 must beat the
-    CPU 422 req/s line, n=64 and the storm must complete in-window),
-    then a profiler trace at the best verify config."""
+    """Round-5 queue, in VERDICT priority order: the n=16 consensus
+    thesis experiment leads (next #1: it must beat the CPU 422 req/s
+    line, and it is short, so even a brief healthy window yields the
+    round's highest-value evidence), then the w6 A/B (next #2), the
+    rest of the consensus ladder (n=64 + storm must complete
+    in-window), and a profiler trace at the best verify config."""
     done = _ok_map(results)
 
     def ready(name: str) -> bool:
