@@ -36,6 +36,13 @@ import sys
 import time
 from typing import Dict, List
 
+if os.environ.get("BENCH_FORCE_CPU") == "1":
+    # exercise --verifier tpu plumbing without the chip (must run before
+    # any simple_pbft_tpu import touches a jax backend)
+    from simple_pbft_tpu import force_cpu
+
+    force_cpu()
+
 
 def _emit(rec: dict, out_path: str | None) -> None:
     line = json.dumps(rec)
@@ -112,7 +119,9 @@ def build_traffic(cfg, keys, n_clients: int, blocks: int, batch: int):
     return wire, blocks * batch
 
 
-async def run_mode(mode: str, n: int, blocks: int, batch: int) -> dict:
+async def run_mode(
+    mode: str, n: int, blocks: int, batch: int, verifier: str = "cpu"
+) -> dict:
     from simple_pbft_tpu.app import KVStore
     from simple_pbft_tpu.config import make_test_committee
     from simple_pbft_tpu.consensus.replica import Replica
@@ -132,12 +141,33 @@ async def run_mode(mode: str, n: int, blocks: int, batch: int) -> dict:
     wire, total_reqs = build_traffic(cfg, keys, n_clients, blocks, batch)
     prep_s = time.perf_counter() - t0
 
+    svc = None
+    if verifier == "tpu":
+        # the per-replica form of the TPU thesis: one replica, verify
+        # offloaded through the coalescing service (async dispatch
+        # overlaps the device pass with the next sweep's decode)
+        import simple_pbft_tpu
+        from simple_pbft_tpu.crypto.coalesce import VerifyService
+        from simple_pbft_tpu.crypto.tpu_verifier import TpuVerifier
+
+        simple_pbft_tpu.enable_jit_cache()
+        dev = TpuVerifier(initial_keys=n + n_clients + 8)
+        # default warm budget covers a maximal drain sweep; RU_MAX_SWEEP
+        # shrinks it for CPU smoke runs (each bucket is a 40-150 s
+        # compile on a small CPU host; cached on the chip host)
+        dev.warm_for_population(
+            [kp.pub for kp in keys.values()],
+            max_sweep=int(os.environ.get("RU_MAX_SWEEP", "4096")),
+        )
+        svc = VerifyService(dev)
+
     replica = Replica(
         node_id="r1",
         cfg=cfg,
         seed=keys["r1"].seed,
         transport=net.endpoint("r1"),
         app=KVStore(),
+        verifier=svc,
     )
     feeder = net.endpoint("r0")
     for raw in wire:
@@ -185,7 +215,22 @@ async def run_mode(mode: str, n: int, blocks: int, batch: int) -> dict:
         "checkpointing": "emit-only (no peers answer)",
         "verifier": getattr(replica.verifier, "name", "?"),
     }
+    if svc is not None:
+        import jax
+
+        rec.update(
+            platform=jax.devices()[0].platform,
+            svc_device_passes=svc.device_passes,
+            svc_cpu_passes=svc.cpu_passes,
+            # null until a device pass ran — the EMA's constructor seed
+            # (30 ms) must never read as a measured round trip
+            svc_rtt_ms_ema=(
+                round(svc.rtt_ms, 1) if svc.device_passes else None
+            ),
+        )
     await replica.stop()
+    if svc is not None:
+        svc.close()
     return rec
 
 
@@ -195,6 +240,7 @@ async def main() -> None:
     ap.add_argument("--blocks", type=int, default=16)
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--modes", default="plain,qc")
+    ap.add_argument("--verifier", default="cpu", choices=["cpu", "tpu"])
     ap.add_argument(
         "--out", default=os.path.join("bench_results", "replica_unit_r05.jsonl")
     )
@@ -202,7 +248,9 @@ async def main() -> None:
     for mode in args.modes.split(","):
         mode = mode.strip()
         assert mode in ("plain", "qc"), mode
-        rec = await run_mode(mode, args.n, args.blocks, args.batch)
+        rec = await run_mode(
+            mode, args.n, args.blocks, args.batch, verifier=args.verifier
+        )
         _emit(rec, args.out)
 
 

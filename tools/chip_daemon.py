@@ -404,6 +404,22 @@ def next_experiment(results: list[dict]) -> dict | None:
                 "--crashes", "1", "--seconds", "45",
             ],
         )
+    # 3b. per-replica TPU thesis: one replica, verify offloaded to the
+    #     chip through the coalescing service (cpu_budget_r05.md predicts
+    #     ~3x the CPU unit ceiling if the offload overlaps)
+    if ready("replica_unit_tpu"):
+        return {
+            "exp": "replica_unit_tpu",
+            "cmd": [
+                sys.executable, os.path.join(REPO, "bench_replica_unit.py"),
+                "--n", "100", "--blocks", "24", "--batch", "256",
+                "--modes", "plain", "--verifier", "tpu",
+            ],
+            "env": dict(os.environ),
+            "env_extra": {"args": "n100 plain tpu"},
+            "timeout": 1800.0,
+            "kind": "replica_unit",
+        }
     # 4. longer windows once the short ones commit
     if "consensus_n16" in done and ready("consensus_n16_long"):
         return _consensus_exp(
@@ -467,6 +483,28 @@ def _run_experiment(exp: dict) -> None:
             }
         )
         _log(f"{exp['exp']}: ok={ok} rec={rec}")
+    elif exp["kind"] == "replica_unit":
+        recs = [ln for ln in lines if ln.get("bench") == "replica_unit"]
+        # TPU-thesis evidence requires the CHIP to have done the work: a
+        # jax CPU fallback, or an adaptive cutoff that routed every
+        # sweep to the CPU path, is not a device result (same guard as
+        # the 'bench' kind's platform check)
+        ok = bool(recs) and all(
+            ln.get("ok")
+            and ln.get("req_s", 0) > 0
+            and ln.get("platform") not in (None, "cpu")
+            and ln.get("svc_device_passes", 0) > 0
+            for ln in recs
+        )
+        _append(
+            {
+                "exp": exp["exp"], "ok": ok, "elapsed_s": elapsed,
+                "env_extra": exp["env_extra"],
+                "rec": recs[-1] if recs else None, "all_recs": recs,
+                "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            }
+        )
+        _log(f"{exp['exp']}: ok={ok} recs={recs}")
     else:
         recs = [ln for ln in lines if "committed_req_s" in ln]
         # ok keys on the FULL-RUN rate (VERDICT r4 weak #2 / next #7): a
