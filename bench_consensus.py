@@ -207,15 +207,21 @@ async def run_config(
         # window, with the committee's REAL key population so the warmed
         # shapes are the ones live sweeps hit. _shared_jit makes the
         # compiles process-wide, so one warmer covers all n replicas.
-        # A backup's drain sweep can batch a whole proposal (batch
-        # client sigs + 1) plus a round of votes from every peer.
-        need = batch + 1 + 4 * n + 64
+        # The warm budget must cover the COALESCED maximum, not one
+        # replica's sweep: the service folds every replica's pending
+        # items into one pile, so the first busy moment hits the top
+        # bucket — an unwarmed bucket is a minutes-long compile at
+        # dispatch, stalling the whole committee (caught by the r5
+        # forced-CPU preflight: svc_max_coalesced=1917 wedged in the
+        # 2048-bucket compile, zero commits).
+        from simple_pbft_tpu.crypto.tpu_verifier import BUCKETS
+
         t0 = time.perf_counter()
         shared_verifier.warm_for_population(
-            [kp.pub for kp in com.keys.values()], max_sweep=need
+            [kp.pub for kp in com.keys.values()], max_sweep=BUCKETS[-1]
         )
         print(
-            f"warmed sweeps <= {need} at table cap "
+            f"warmed sweeps <= {BUCKETS[-1]} at table cap "
             f"{shared_verifier._bank._cap} "
             f"in {time.perf_counter() - t0:.0f}s",
             file=sys.stderr,

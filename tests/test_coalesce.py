@@ -195,6 +195,40 @@ def test_committee_commits_through_coalescing_service():
     run(scenario())
 
 
+def test_committee_commits_through_real_device_route():
+    """The on-chip shape, end to end on the CPU backend: every replica
+    fronts one service over a REAL TpuVerifier with the CPU path
+    disabled, so every sweep rides an actual jitted device pass (tiny
+    buckets keep XLA-CPU pass time sub-second). Pins the full chain the
+    chip experiments run: replica -> submit -> coalesce -> dispatch ->
+    finisher -> future -> quorum -> execute."""
+
+    async def scenario():
+        from simple_pbft_tpu.crypto.tpu_verifier import TpuVerifier
+
+        dev = TpuVerifier(initial_keys=16)
+        svc = VerifyService(dev, cpu_cutoff=0, max_batch=32)
+        com = LocalCommittee.build(
+            n=4, clients=1, verifier_factory=lambda: svc, max_batch=8
+        )
+        dev.warm_for_population(
+            [kp.pub for kp in com.keys.values()], max_sweep=32
+        )
+        com.start()
+        try:
+            res = await asyncio.gather(
+                *(com.clients[0].submit(f"put k{i} v{i}") for i in range(6))
+            )
+            assert res == ["ok"] * 6
+        finally:
+            await com.stop()
+            svc.close()
+        assert svc.device_passes > 0 and svc.cpu_passes == 0
+        assert len({r.app.state_digest() for r in com.replicas}) == 1
+
+    run(scenario(), timeout=300)
+
+
 def test_bad_signature_still_rejected_through_service():
     """Byzantine semantics survive the coalescing front: a forged vote
     is dropped while the quorum still forms from valid ones."""

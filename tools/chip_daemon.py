@@ -346,6 +346,7 @@ def _bench_exp(name: str, env_extra: dict, timeout: float = 900.0) -> dict:
 
 def _consensus_exp(name: str, args: list[str], timeout: float = 2400.0) -> dict:
     env = dict(os.environ, BENCH_CONSENSUS_TIMEOUT=f"{timeout:.0f}")
+    env.pop("BENCH_FORCE_CPU", None)  # operator-shell leftover = CPU burn
     return {
         "exp": name,
         "cmd": [sys.executable, os.path.join(REPO, "bench_consensus.py"), *args],
@@ -408,6 +409,12 @@ def next_experiment(results: list[dict]) -> dict | None:
     #     chip through the coalescing service (cpu_budget_r05.md predicts
     #     ~3x the CPU unit ceiling if the offload overlaps)
     if ready("replica_unit_tpu"):
+        # pin the bench's env knobs: a leftover operator-shell
+        # BENCH_FORCE_CPU=1 would burn every attempt on the CPU backend,
+        # and a smoke-sized RU_MAX_SWEEP would leave the big buckets
+        # unwarmed (= an on-chip compile stall mid-run)
+        env = dict(os.environ, RU_MAX_SWEEP="4096")
+        env.pop("BENCH_FORCE_CPU", None)
         return {
             "exp": "replica_unit_tpu",
             "cmd": [
@@ -415,7 +422,7 @@ def next_experiment(results: list[dict]) -> dict | None:
                 "--n", "100", "--blocks", "24", "--batch", "256",
                 "--modes", "plain", "--verifier", "tpu",
             ],
-            "env": dict(os.environ),
+            "env": env,
             "env_extra": {"args": "n100 plain tpu"},
             "timeout": 1800.0,
             "kind": "replica_unit",
