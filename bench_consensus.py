@@ -130,6 +130,10 @@ async def run_config(
 
     factory = None
     n_keys = n + n_clients + 8  # committee + clients + headroom
+    if verifier == "insecure":
+        from simple_pbft_tpu.crypto.verifier import InsecureVerifier
+
+        factory = InsecureVerifier
     if verifier == "tpu":
         import simple_pbft_tpu
 
@@ -171,7 +175,7 @@ async def run_config(
     # (measured: storm-on-chip with verify_calls=0 — not one drain sweep
     # completed). Scale the timer to the verify backend; co-located TPU
     # deployments (ms dispatches) can pass --view-timeout to tighten it.
-    degraded_vt = 3.0 if verifier == "cpu" else 15.0
+    degraded_vt = 3.0 if verifier in ("cpu", "insecure") else 15.0
     com = LocalCommittee.build(
         n=n,
         clients=n_clients,
@@ -216,12 +220,16 @@ async def run_config(
         # 2048-bucket compile, zero commits).
         from simple_pbft_tpu.crypto.tpu_verifier import BUCKETS
 
+        # coalesced bound: n replicas' maximal sweeps folded together,
+        # capped at the service's max batch — small configs then skip
+        # the top-bucket compiles their piles provably cannot reach
+        need = min(BUCKETS[-1], n * (batch + 1 + 4 * n + 64))
         t0 = time.perf_counter()
         shared_verifier.warm_for_population(
-            [kp.pub for kp in com.keys.values()], max_sweep=BUCKETS[-1]
+            [kp.pub for kp in com.keys.values()], max_sweep=need
         )
         print(
-            f"warmed sweeps <= {BUCKETS[-1]} at table cap "
+            f"warmed sweeps <= {need} at table cap "
             f"{shared_verifier._bank._cap} "
             f"in {time.perf_counter() - t0:.0f}s",
             file=sys.stderr,
@@ -381,7 +389,13 @@ async def run_config(
 async def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="1")
-    ap.add_argument("--verifier", default="cpu", choices=["cpu", "tpu"])
+    # insecure = accept-everything backend: measures the consensus-plane
+    # ceiling with verification free — the asymptote a fully-overlapped
+    # device offload approaches (and reference-parity mode: the
+    # reference verifies nothing)
+    ap.add_argument(
+        "--verifier", default="cpu", choices=["cpu", "tpu", "insecure"]
+    )
     ap.add_argument("--seconds", type=float, default=10.0)
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--outstanding", type=int, default=128)

@@ -325,9 +325,20 @@ def _append(rec: dict) -> None:
         f.write(json.dumps(rec) + "\n")
 
 
+def _clean_env(**overrides) -> dict:
+    """Experiment-subprocess env: the daemon's environment minus
+    operator-shell leftovers that would silently reroute a chip attempt
+    onto the CPU backend or shrink its warm budget (the smoke knobs of
+    the very benches these experiments run)."""
+    env = dict(os.environ, **overrides)
+    for leftover in ("BENCH_FORCE_CPU", "RU_MAX_SWEEP", "BENCH_SMOKE"):
+        if leftover not in overrides:
+            env.pop(leftover, None)
+    return env
+
+
 def _bench_exp(name: str, env_extra: dict, timeout: float = 900.0) -> dict:
-    env = dict(
-        os.environ,
+    env = _clean_env(
         BENCH_MODE="fused",
         BENCH_RAMP="fast",
         BENCH_TIMEOUT=f"{timeout:.0f}",
@@ -345,8 +356,7 @@ def _bench_exp(name: str, env_extra: dict, timeout: float = 900.0) -> dict:
 
 
 def _consensus_exp(name: str, args: list[str], timeout: float = 2400.0) -> dict:
-    env = dict(os.environ, BENCH_CONSENSUS_TIMEOUT=f"{timeout:.0f}")
-    env.pop("BENCH_FORCE_CPU", None)  # operator-shell leftover = CPU burn
+    env = _clean_env(BENCH_CONSENSUS_TIMEOUT=f"{timeout:.0f}")
     return {
         "exp": name,
         "cmd": [sys.executable, os.path.join(REPO, "bench_consensus.py"), *args],
@@ -409,12 +419,6 @@ def next_experiment(results: list[dict]) -> dict | None:
     #     chip through the coalescing service (cpu_budget_r05.md predicts
     #     ~3x the CPU unit ceiling if the offload overlaps)
     if ready("replica_unit_tpu"):
-        # pin the bench's env knobs: a leftover operator-shell
-        # BENCH_FORCE_CPU=1 would burn every attempt on the CPU backend,
-        # and a smoke-sized RU_MAX_SWEEP would leave the big buckets
-        # unwarmed (= an on-chip compile stall mid-run)
-        env = dict(os.environ, RU_MAX_SWEEP="4096")
-        env.pop("BENCH_FORCE_CPU", None)
         return {
             "exp": "replica_unit_tpu",
             "cmd": [
@@ -422,7 +426,7 @@ def next_experiment(results: list[dict]) -> dict | None:
                 "--n", "100", "--blocks", "24", "--batch", "256",
                 "--modes", "plain", "--verifier", "tpu",
             ],
-            "env": env,
+            "env": _clean_env(RU_MAX_SWEEP="4096"),
             "env_extra": {"args": "n100 plain tpu"},
             "timeout": 1800.0,
             "kind": "replica_unit",
