@@ -220,10 +220,15 @@ async def run_config(
         # 2048-bucket compile, zero commits).
         from simple_pbft_tpu.crypto.tpu_verifier import BUCKETS
 
-        # coalesced bound: n replicas' maximal sweeps folded together,
-        # capped at the service's max batch — small configs then skip
-        # the top-bucket compiles their piles provably cannot reach
-        need = min(BUCKETS[-1], n * (batch + 1 + 4 * n + 64))
+        # Warm EVERY bucket: a per-round arithmetic bound is unsound —
+        # while a multi-second device pass is in flight, each replica's
+        # transport backlog accumulates several rounds (multiple
+        # pre-prepares x batch client sigs per sweep, max_drain=4096
+        # messages), and the service coalesces all replicas' sweeps, so
+        # any bucket up to the service max is reachable under load. An
+        # unwarmed bucket is a minutes-long compile under the device
+        # lock mid-window; warm time is paid once, off the clock.
+        need = BUCKETS[-1]
         t0 = time.perf_counter()
         shared_verifier.warm_for_population(
             [kp.pub for kp in com.keys.values()], max_sweep=need

@@ -229,6 +229,45 @@ def test_committee_commits_through_real_device_route():
     run(scenario(), timeout=300)
 
 
+def test_failover_through_coalescing_service():
+    """The storm-on-chip shape: the primary crashes while every replica
+    fronts the SAME service over a real device route. View change —
+    whose certificate verifies also ride the service — must elect a new
+    primary and keep committing."""
+
+    async def scenario():
+        from simple_pbft_tpu.crypto.tpu_verifier import TpuVerifier
+
+        dev = TpuVerifier(initial_keys=16)
+        svc = VerifyService(dev, cpu_cutoff=0, max_batch=32)
+        com = LocalCommittee.build(
+            n=4,
+            clients=1,
+            verifier_factory=lambda: svc,
+            max_batch=8,
+            view_timeout=1.5,  # headroom: XLA-CPU device passes are slow
+        )
+        dev.warm_for_population(
+            [kp.pub for kp in com.keys.values()], max_sweep=32
+        )
+        com.start()
+        client = com.clients[0]
+        client.request_timeout = 1.0
+        try:
+            assert await client.submit("put a 1") == "ok"
+            com.replica("r0").kill()
+            assert await client.submit("put b 2", retries=120) == "ok"
+            survivors = [r for r in com.replicas if r.id != "r0"]
+            assert all(r.view >= 1 for r in survivors)
+            assert await client.submit("get a", retries=120) == "1"
+        finally:
+            await com.stop()
+            svc.close()
+        assert svc.device_passes > 0
+
+    run(scenario(), timeout=300)
+
+
 def test_bad_signature_still_rejected_through_service():
     """Byzantine semantics survive the coalescing front: a forged vote
     is dropped while the quorum still forms from valid ones."""
