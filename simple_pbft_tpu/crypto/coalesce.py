@@ -53,10 +53,10 @@ class VerifyService:
 
     name = "tpu-coalesced"
 
-    # dispatch policy knobs (see _take_locked): a second in-flight device
-    # call is only worth its dispatch overhead when the pending pile is
-    # already substantial; below that, waiting for the in-flight call to
-    # land coalesces harder for free.
+    # dispatch policy knobs (see _dispatch_loop): a second in-flight
+    # device call is only worth its dispatch overhead when the pending
+    # pile is already substantial; below that, waiting for the in-flight
+    # call to land coalesces harder for free.
     MIN_SECOND_DISPATCH = 256
     MAX_DEPTH = 2
 
@@ -209,17 +209,28 @@ class VerifyService:
                 break
         return subs, total
 
+    def _can_dispatch_locked(self) -> bool:
+        """Something pending can make progress NOW. Round-4 chip evidence
+        (chip_r04.jsonl n16 6.4 req/s, p50 10.9 s) traced to the old
+        policy holding EVERY pile — including a 15-item quorum sweep —
+        behind the in-flight device pass, so each consensus phase gate
+        paid a full tunnel RTT. Small piles must never wait: the CPU
+        path clears them in ~1 ms while the device absorbs the bulk."""
+        if not self._pending:
+            return False
+        if self._pending_items <= self._cutoff():
+            return True  # CPU path (or a free device slot) is immediate
+        if self._inflight >= self.MAX_DEPTH:
+            return False  # big pile, depth full: wait for a slot
+        return (
+            self._inflight == 0
+            or self._pending_items >= self.MIN_SECOND_DISPATCH
+        )
+
     def _dispatch_loop(self) -> None:
         while True:
             with self._cond:
-                while not self._closed and (
-                    not self._pending
-                    or self._inflight >= self.MAX_DEPTH
-                    or (
-                        self._inflight > 0
-                        and self._pending_items < self.MIN_SECOND_DISPATCH
-                    )
-                ):
+                while not self._closed and not self._can_dispatch_locked():
                     self._cond.wait()
                 if self._closed and not self._pending:
                     # FIFO shutdown: the sentinel reaches the completion
@@ -232,7 +243,18 @@ class VerifyService:
                 subs, total = self._take_locked()
                 if not subs:
                     continue
-                route_cpu = total <= self._cutoff() and self._inflight == 0
+                # routing is by size ALONE: piles <= cutoff clear on the
+                # CPU in ~total/cpu_rate ms no matter what the device is
+                # doing; piles > cutoff (CPU time would exceed half an
+                # RTT) go to the device. The adaptive cutoff moves with
+                # the EMAs between the gate check and here, so the depth
+                # bound is re-asserted rather than assumed: a pile the
+                # gate admitted as small that now reads big must not
+                # become a depth-exceeding third device pass.
+                route_cpu = (
+                    total <= self._cutoff()
+                    or self._inflight >= self.MAX_DEPTH
+                )
                 if not route_cpu:
                     self._inflight += 1
             batch: List[BatchItem] = []
