@@ -246,14 +246,20 @@ class VerifyService:
                 # routing is by size ALONE: piles <= cutoff clear on the
                 # CPU in ~total/cpu_rate ms no matter what the device is
                 # doing; piles > cutoff (CPU time would exceed half an
-                # RTT) go to the device. The adaptive cutoff moves with
-                # the EMAs between the gate check and here, so the depth
-                # bound is re-asserted rather than assumed: a pile the
-                # gate admitted as small that now reads big must not
-                # become a depth-exceeding third device pass.
-                route_cpu = (
-                    total <= self._cutoff()
-                    or self._inflight >= self.MAX_DEPTH
+                # RTT) go to the device. The ADAPTIVE cutoff moves with
+                # the EMAs between the gate check and here, so for it the
+                # depth bound is re-asserted rather than assumed: a pile
+                # the gate admitted as small that now reads big must not
+                # become a depth-exceeding third device pass. A FIXED
+                # cutoff never moves, so that clause must not apply — a
+                # device-only service (cpu_cutoff=0) draining its backlog
+                # at close() keeps its items off the CPU path, briefly
+                # exceeding MAX_DEPTH instead (a dispatch-overlap policy,
+                # not a correctness bound; the verifier serializes device
+                # access itself).
+                route_cpu = total <= self._cutoff() or (
+                    self._fixed_cutoff is None
+                    and self._inflight >= self.MAX_DEPTH
                 )
                 if not route_cpu:
                     self._inflight += 1

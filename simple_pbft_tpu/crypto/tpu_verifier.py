@@ -281,17 +281,24 @@ class KeyBank:
         else:
             self._builder = lambda pt: comb.fused_table_np(pt, window)
             self._rows_per_key = comb.npos_for(window) * (1 << (2 * window))
-            # cap device table memory at ~1 GB whatever the window
-            # (w=4: ~4.2 MB/key -> 256 keys; w=5: ~13.6 MB -> 78;
-            # w=6: ~45 MB -> 23); over-cap keys fall back to the CPU path
-            default_max = max(8, (1 << 30) // (self._rows_per_key * comb.ROW * 4))
+            # cap device table memory at ~2 GB whatever the window
+            # (w=4: ~4.2 MB/key -> 512 keys; w=5: ~13.6 MB -> 157;
+            # w=6: ~45 MB -> 46); over-cap keys fall back to the CPU
+            # path. 2 GB was chosen against the v5e-lite chip: an n=256
+            # committee + clients is 264 keys = 1.11 GB at w=4, and the
+            # old 1 GB budget pushed exactly the CLIENT keys (registered
+            # after the replicas, signing every request — the bulk of
+            # the verify load) over the cap (chip_r05.jsonl
+            # consensus_qc256_tpu attempt 1: one 8127-item pile stalled
+            # ~75 s on the scalar fallback, committee committed zero).
+            default_max = max(8, (2 << 30) // (self._rows_per_key * comb.ROW * 4))
         self._index: Dict[bytes, int] = {}
         self._invalid_cache: set = set()
         self._max_keys = default_max if max_keys is None else max_keys
         # clamp: capacity beyond max_keys would allocate (and upload)
         # table memory the lookup path refuses to ever use — at w=6 a
-        # 64-slot bank is ~2.9 GB against the ~1 GB budget max_keys
-        # enforces
+        # 64-slot bank is ~2.9 GB against the ~2 GB budget max_keys
+        # enforces (46 keys)
         self._cap = max(1, min(initial_capacity, self._max_keys))
         self._np = np.zeros((self._cap, self._rows_per_key, comb.ROW), np.int32)
         self._dev = None
@@ -558,6 +565,7 @@ class TpuVerifier:
             if mode in ("comb", "fused")
             else None
         )
+        self._cpu_fb = None  # lazy batched native verifier (over-cap keys)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -763,9 +771,21 @@ class TpuVerifier:
             # LOWER bound on the device rate when calls overlap).
             with _DEVICE_LOCK:
                 self.device_seconds += time.perf_counter() - t0
-            for i in fallback:  # keys over the bank cap: CPU path
-                it = items[i]
-                verdict[i] = ref.verify(it.pubkey, it.msg, it.sig)
+            if fallback:
+                if self._cpu_fb is None:
+                    from .verifier import best_cpu_verifier
+
+                    self._cpu_fb = best_cpu_verifier()
+                # keys over the bank cap: ONE batched native-CPU pass,
+                # not a scalar loop — at n=256 the over-cap keys were
+                # the clients', i.e. most of the pile, and the
+                # pure-Python per-item path turned one coalesced batch
+                # into a ~75 s stall (chip_r05.jsonl qc256 attempt 1)
+                fb_out = self._cpu_fb.verify_batch(
+                    [items[i] for i in fallback]
+                )
+                for i, ok_i in zip(fallback, fb_out):
+                    verdict[i] = ok_i
             return verdict[: prep.n].tolist()
 
         return finish

@@ -58,6 +58,12 @@ class OpenSSLVerifier:
 
     name = "openssl"
 
+    MAX_KEYS = 8192  # parsed-key cache bound: an adversarial client
+    # spraying fresh valid curve points must not grow host memory
+    # without bound (same rationale as NativeEdVerifier.MAX_KEYS; this
+    # verifier also serves as the TpuVerifier's over-bank-cap fallback,
+    # which sees exactly that traffic shape)
+
     def __init__(self) -> None:
         from cryptography.hazmat.primitives.asymmetric.ed25519 import (
             Ed25519PublicKey,
@@ -75,6 +81,9 @@ class OpenSSLVerifier:
                 pk = self._cache.get(it.pubkey)
                 if pk is None:
                     pk = self._load(it.pubkey)
+                    if len(self._cache) >= self.MAX_KEYS:
+                        self._cache.clear()  # rare full reset beats LRU
+                        # bookkeeping on this hot path
                     self._cache[it.pubkey] = pk
                 pk.verify(it.sig, it.msg)
                 out.append(True)

@@ -434,22 +434,30 @@ def _interleave(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([a, b], axis=2).reshape(a.shape[0], -1)
 
 
+CHAIN_WIDTH = 128  # one full VREG of lanes: the Fermat chain is as cheap
+# on (17, 128) as on (17, 1), so the tree stops here — the levels below
+# ran 64..1-wide on 128-wide vector lanes, pure sequential-dependency
+# waste (the r4 chip profile charged ~23% of the verify pass to this
+# tail for ~1.3% of its field muls).
+
+
 def batch_invert(z: jnp.ndarray) -> jnp.ndarray:
     """Tree-structured Montgomery batch inversion: (17, B) -> (17, B).
 
-    Pairwise products up the tree (log2 B batched muls totalling ≈ B
-    multiplies), ONE scalar invert chain at the root, then unfold back
-    down (≈ 2B multiplies). Requires B a power of two and all inputs
-    nonzero — guaranteed for Z coordinates of complete Edwards formulas.
+    Pairwise products up the tree (log2(B/CHAIN_WIDTH) batched muls
+    totalling ≈ B multiplies), ONE lane-parallel Fermat chain across the
+    whole CHAIN_WIDTH-wide root level, then unfold back down (≈ 2B
+    multiplies). Requires B a power of two and all inputs nonzero —
+    guaranteed for Z coordinates of complete Edwards formulas.
     """
     n = z.shape[1]
     assert n & (n - 1) == 0, "batch_invert requires a power-of-two batch"
     levels = []
     cur = z
-    while cur.shape[1] > 1:
+    while cur.shape[1] > CHAIN_WIDTH:
         levels.append(cur)
         cur = fe.mul(cur[:, 0::2], cur[:, 1::2])
-    inv = fe.invert(cur)  # (17, 1) — the only exponentiation chain
+    inv = fe.invert(cur)  # the only exponentiation chain, all lanes busy
     for lev in reversed(levels):
         left, right = lev[:, 0::2], lev[:, 1::2]
         inv = _interleave(fe.mul(inv, right), fe.mul(inv, left))

@@ -152,6 +152,11 @@ def test_queue_next_experiment_order(tmp_path, monkeypatch):
     """The round-5 queue leads with the thesis experiment (n=16
     consensus on chip), then the w6 A/B; attempts are bounded."""
     monkeypatch.setattr(chip_daemon, "OUT", str(tmp_path / "q.jsonl"))
+    # isolate from the repo's live operator override file — this test
+    # pins the STATIC queue order
+    monkeypatch.setattr(
+        chip_daemon, "QUEUE_OVERRIDE", str(tmp_path / "no_override.json")
+    )
     results = []
     exp = chip_daemon.next_experiment(results)
     assert exp["exp"] == "consensus_n16"
@@ -161,3 +166,38 @@ def test_queue_next_experiment_order(tmp_path, monkeypatch):
     for _ in range(chip_daemon.MAX_ATTEMPTS):
         results.append({"exp": "verify_w6", "ok": False})
     assert chip_daemon.next_experiment(results)["exp"] == "verify_w5"
+
+
+def test_queue_override_file(tmp_path, monkeypatch):
+    """Operator-queued experiments (chip_queue_<round>.json) run before
+    the static queue, in file order, with attempt bounds; malformed
+    specs are skipped without killing the queue; JSON-number env values
+    are coerced to strings for subprocess.run."""
+    ovr = tmp_path / "override.json"
+    monkeypatch.setattr(chip_daemon, "QUEUE_OVERRIDE", str(ovr))
+    monkeypatch.setattr(chip_daemon, "OUT", str(tmp_path / "q.jsonl"))
+    ovr.write_text(json.dumps([
+        {"exp": "ab_one", "kind": "bench",
+         "env": {"BENCH_WINDOW": 5, "BENCH_BATCH": 16384}, "timeout": 60},
+        {"exp": "bad_spec", "kind": "consensus", "args": "--configs 2"},
+        {"exp": "ab_two", "kind": "consensus",
+         "args": ["--configs", "2", "--seconds", 20]},
+    ]))
+    results = []
+    exp = chip_daemon.next_experiment(results)
+    assert exp["exp"] == "ab_one"
+    # env coercion: every value a string (subprocess.run requirement)
+    assert exp["env"]["BENCH_WINDOW"] == "5"
+    assert exp["env"]["BENCH_BATCH"] == "16384"
+    results.append({"exp": "ab_one", "ok": True, "rec": {"value": 1.0}})
+    # the malformed string-args spec is skipped, not exploded char-wise
+    exp = chip_daemon.next_experiment(results)
+    assert exp["exp"] == "ab_two"
+    assert exp["cmd"][-2:] == ["--seconds", "20"]
+    # attempt bound applies to override experiments too
+    for _ in range(chip_daemon.MAX_ATTEMPTS):
+        results.append({"exp": "ab_two", "ok": False})
+    assert chip_daemon.next_experiment(results)["exp"] == "consensus_n16"
+    # a corrupt file is ignored, falling through to the static queue
+    ovr.write_text("{not json")
+    assert chip_daemon.next_experiment([])["exp"] == "consensus_n16"

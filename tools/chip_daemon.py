@@ -367,6 +367,72 @@ def _consensus_exp(name: str, args: list[str], timeout: float = 2400.0) -> dict:
     }
 
 
+def _replica_unit_exp(
+    name: str, args: list[str], timeout: float = 1800.0, **env_overrides
+) -> dict:
+    return {
+        "exp": name,
+        "cmd": [
+            sys.executable,
+            os.path.join(REPO, "bench_replica_unit.py"),
+            *args,
+        ],
+        "env": _clean_env(**env_overrides),
+        "env_extra": {"args": args},
+        "timeout": timeout,
+        "kind": "replica_unit",
+    }
+
+
+QUEUE_OVERRIDE = os.path.join(
+    REPO, "bench_results", f"chip_queue_{ROUND}.json"
+)
+
+
+def _override_experiments() -> list[dict]:
+    """Operator-editable experiment specs, consulted BEFORE the static
+    queue so new experiments (a post-fix re-run, an A/B) can be added
+    without restarting a daemon that is mid-experiment. File format:
+    a JSON list of {"exp", "kind": "consensus"|"bench"|"replica_unit",
+    "args": [...] (consensus/replica_unit) or "env": {...} (bench),
+    "timeout": seconds}. A malformed file is ignored loudly rather than
+    crashing the queue loop."""
+    try:
+        with open(QUEUE_OVERRIDE) as f:
+            specs = json.load(f)
+        assert isinstance(specs, list)
+    except FileNotFoundError:
+        return []
+    except Exception as e:  # noqa: BLE001
+        _log(f"queue override unreadable ({e!r}); ignoring")
+        return []
+    out = []
+    for spec in specs:
+        try:
+            name = spec["exp"]
+            kind = spec.get("kind", "consensus")
+            timeout = float(spec.get("timeout", 2400.0))
+            args = spec.get("args", [])
+            if not isinstance(args, list):
+                raise TypeError(f"args must be a list, got {type(args).__name__}")
+            # JSON numbers/bools are natural in an env map but
+            # subprocess.run(env=...) requires strings — coerce here so a
+            # spec like {"BENCH_BATCH": 16384} works instead of killing
+            # the queue loop
+            env = {str(k): str(v) for k, v in dict(spec.get("env", {})).items()}
+            if kind == "bench":
+                out.append(_bench_exp(name, env, timeout))
+            elif kind == "replica_unit":
+                out.append(
+                    _replica_unit_exp(name, [str(a) for a in args], timeout, **env)
+                )
+            else:
+                out.append(_consensus_exp(name, [str(a) for a in args], timeout))
+        except Exception as e:  # noqa: BLE001
+            _log(f"queue override spec {spec!r} malformed ({e!r}); skipping")
+    return out
+
+
 def _ok_map(results: list[dict]) -> dict[str, dict]:
     return {r["exp"]: r for r in results if r.get("ok")}
 
@@ -386,6 +452,12 @@ def next_experiment(results: list[dict]) -> dict | None:
 
     def ready(name: str) -> bool:
         return name not in done and _attempts(results, name) < MAX_ATTEMPTS
+
+    # 0. operator-queued experiments (chip_queue_<round>.json), in file
+    #    order — the no-restart path for post-fix re-runs and A/Bs
+    for exp in _override_experiments():
+        if ready(exp["exp"]):
+            return exp
 
     # 1. the thesis experiment (VERDICT next #1, the round's headline):
     #    n=16 consensus with the coalescing TPU verify service — short,
@@ -419,18 +491,14 @@ def next_experiment(results: list[dict]) -> dict | None:
     #     chip through the coalescing service (cpu_budget_r05.md predicts
     #     ~3x the CPU unit ceiling if the offload overlaps)
     if ready("replica_unit_tpu"):
-        return {
-            "exp": "replica_unit_tpu",
-            "cmd": [
-                sys.executable, os.path.join(REPO, "bench_replica_unit.py"),
+        return _replica_unit_exp(
+            "replica_unit_tpu",
+            [
                 "--n", "100", "--blocks", "24", "--batch", "256",
                 "--modes", "plain", "--verifier", "tpu",
             ],
-            "env": _clean_env(RU_MAX_SWEEP="4096"),
-            "env_extra": {"args": "n100 plain tpu"},
-            "timeout": 1800.0,
-            "kind": "replica_unit",
-        }
+            RU_MAX_SWEEP="4096",
+        )
     # 4. longer windows once the short ones commit
     if "consensus_n16" in done and ready("consensus_n16_long"):
         return _consensus_exp(
