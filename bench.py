@@ -185,7 +185,13 @@ def _worker_main() -> None:
     # w5 777k / skew 322k verifies/s (bench_results/chip_r04.jsonl), so
     # the driver's bare `python bench.py` run measures the best config.
     wbits = int(os.environ.get("BENCH_WINDOW", "5")) if mode == "fused" else 4
-    _sticky.update(mode=mode, window=wbits, mul=mul_impl)
+    # BENCH_ROWPACK=1: 15-bit limb pairs share an int32 in the table
+    # rows (128-byte rows instead of 256), halving the madd gather's HBM
+    # traffic for two shift/mask ops per element — fused mode only. The
+    # switch must precede KeyBank construction and every jit trace.
+    rowpack = mode == "fused" and os.environ.get("BENCH_ROWPACK", "0") == "1"
+    comb.use_row_packing(rowpack)
+    _sticky.update(mode=mode, window=wbits, mul=mul_impl, rowpack=rowpack)
     _best["note"] = "querying devices (tunnel attach)"
     platform = jax.devices()[0].platform
     _sticky["platform"] = platform

@@ -52,7 +52,24 @@ from ..crypto import ed25519_cpu as ref
 NPOS = 64  # 4-bit comb positions covering 256-bit scalars
 WINDOW = 16
 FWINDOW = WINDOW * WINDOW  # fused (s_nibble, k_nibble) window: 256 entries
-ROW = 64  # packed Niels row: 3*17 int32 limbs + 13 pad to a 256B row
+ROW_DENSE = 64  # Niels row: 3*17 int32 limbs + 13 pad to a 256B row
+ROW_PACKED = 32  # two 15-bit limbs per int32: 3*9 words + 5 pad, 128B
+ROW = ROW_DENSE  # active row width — module global, see use_row_packing
+PACKED = False
+
+
+def use_row_packing(on: bool) -> None:
+    """Select the table-row layout BEFORE any table is built or kernel
+    jitted (jit traces and KeyBank allocations capture ROW). Packed rows
+    halve the madd loop's gather bandwidth — the kernel's dominant HBM
+    stream — for two extra shift/mask ops per element at unpack; the
+    A/B lives in the chip ledger as verify_w5_pack. Layouts cannot mix:
+    tables built in one mode are garbage to a kernel traced in the
+    other, which is why this is a process-wide switch and not a
+    per-call flag."""
+    global ROW, PACKED
+    PACKED = bool(on)
+    ROW = ROW_PACKED if on else ROW_DENSE
 
 
 def npos_for(wbits: int) -> int:
@@ -65,10 +82,26 @@ def npos_for(wbits: int) -> int:
 
 
 def _pack_rows_np(vals: np.ndarray) -> np.ndarray:
-    """(n, 3, 17) int32 Niels limbs -> (n, ROW) packed rows."""
+    """(n, 3, 17) int32 Niels limbs -> (n, ROW) packed rows.
+
+    Dense mode (ROW=64): one int32 per limb, 13 pad words — a 256-byte
+    row of which only 204 bytes are payload. Packed mode (ROW=32, see
+    `use_row_packing`): limbs are 15-bit nonnegative values, so pairs
+    share an int32 (lo | hi << 15) — 9 words per element (the 17th limb
+    rides alone), 27 + 5 pad = a 128-byte row. The madd loop's gather is
+    the kernel's dominant HBM stream (r4 profile: staging copies +
+    gather ~45% of the pass with the madds), so halving row bytes buys
+    bandwidth at the cost of two shift/mask ops per element at unpack."""
     n = vals.shape[0]
     out = np.zeros((n, ROW), dtype=np.int32)
-    out[:, : 3 * fe.NLIMB] = vals.reshape(n, 3 * fe.NLIMB)
+    if PACKED:
+        v = vals.reshape(n, 3, fe.NLIMB)
+        packed = np.zeros((n, 3, 9), dtype=np.int32)
+        packed[:, :, :8] = v[:, :, 0:16:2] | (v[:, :, 1:16:2] << 15)
+        packed[:, :, 8] = v[:, :, 16]
+        out[:, : 3 * 9] = packed.reshape(n, 27)
+    else:
+        out[:, : 3 * fe.NLIMB] = vals.reshape(n, 3 * fe.NLIMB)
     return out
 
 
@@ -220,14 +253,34 @@ def windows_major_np(le_bytes: np.ndarray, wbits: int) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _unpack_element(words: jnp.ndarray) -> jnp.ndarray:
+    """(9, ...) packed words -> (17, ...) limbs: lo | hi << 15 pairs for
+    limbs 0..15, the 17th limb rides alone in word 8."""
+    lo = words[:8] & 0x7FFF
+    hi = (words[:8] >> 15) & 0x7FFF
+    pairs = jnp.stack([lo, hi], axis=1).reshape((16,) + words.shape[1:])
+    return jnp.concatenate([pairs, words[8:9]], axis=0)
+
+
 def _row_niels(rows: jnp.ndarray):
-    """Packed rows (ROW, ...) -> (ypx, ymx, xy2d) limb arrays (17, ...)."""
+    """Table rows (ROW, ...) -> (ypx, ymx, xy2d) limb arrays (17, ...).
+    Layout (dense int32-per-limb vs 15-bit pair-packed) is captured at
+    trace time from the module switch (use_row_packing)."""
+    if PACKED:
+        return (
+            _unpack_element(rows[0:9]),
+            _unpack_element(rows[9:18]),
+            _unpack_element(rows[18:27]),
+        )
     n = fe.NLIMB
     return rows[:n], rows[n : 2 * n], rows[2 * n : 3 * n]
 
 
 def negate_rows(rows: jnp.ndarray) -> jnp.ndarray:
-    """Niels negation on packed rows: swap (y+x, y−x), negate 2dxy."""
+    """Niels negation on packed rows: swap (y+x, y−x), negate 2dxy.
+    Dense layout only — the separate-table comb path that needs it never
+    runs packed (use_row_packing gates the fused path's tables)."""
+    assert not PACKED, "negate_rows is a dense-layout (comb-mode) helper"
     ypx, ymx, xy2d = _row_niels(rows)
     return jnp.concatenate(
         [ymx, ypx, fe.neg(xy2d), rows[3 * fe.NLIMB :]], axis=0

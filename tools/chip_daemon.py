@@ -355,13 +355,17 @@ def _bench_exp(name: str, env_extra: dict, timeout: float = 900.0) -> dict:
     }
 
 
-def _consensus_exp(name: str, args: list[str], timeout: float = 2400.0) -> dict:
-    env = _clean_env(BENCH_CONSENSUS_TIMEOUT=f"{timeout:.0f}")
+def _consensus_exp(
+    name: str, args: list[str], timeout: float = 2400.0, **env_overrides
+) -> dict:
+    env = _clean_env(
+        BENCH_CONSENSUS_TIMEOUT=f"{timeout:.0f}", **env_overrides
+    )
     return {
         "exp": name,
         "cmd": [sys.executable, os.path.join(REPO, "bench_consensus.py"), *args],
         "env": env,
-        "env_extra": {"args": args},
+        "env_extra": {"args": args, **env_overrides},
         "timeout": timeout + 120,
         "kind": "consensus",
     }
@@ -427,7 +431,9 @@ def _override_experiments() -> list[dict]:
                     _replica_unit_exp(name, [str(a) for a in args], timeout, **env)
                 )
             else:
-                out.append(_consensus_exp(name, [str(a) for a in args], timeout))
+                out.append(
+                    _consensus_exp(name, [str(a) for a in args], timeout, **env)
+                )
         except Exception as e:  # noqa: BLE001
             _log(f"queue override spec {spec!r} malformed ({e!r}); skipping")
     return out
