@@ -112,3 +112,29 @@ def test_point_roundtrip():
 def test_decompress_invalid():
     # A y-coordinate >= p with no valid x (all-0xff is non-canonical/invalid)
     assert ed.point_decompress(b"\xff" * 32) is None
+
+
+def test_openssl_verifier_key_cache_is_bounded():
+    """The OpenSSL backend's parsed-key cache must not grow without
+    bound under an adversarial fresh-key spray (it serves as the
+    TpuVerifier's over-bank-cap fallback, which sees exactly that
+    traffic shape); verdicts stay correct across the reset."""
+    pytest.importorskip("cryptography")
+    from simple_pbft_tpu.crypto.verifier import BatchItem, OpenSSLVerifier
+
+    v = OpenSSLVerifier()
+    v.MAX_KEYS = 8  # shrink the bound for the test
+    items = []
+    for i in range(20):
+        seed = bytes([i]) * 32
+        msg = b"spray %d" % i
+        items.append(BatchItem(ed.public_key(seed), msg, ed.sign(seed, msg)))
+    bad = BatchItem(items[0].pubkey, b"other", items[0].sig)
+    out = v.verify_batch(items + [bad])
+    assert out == [True] * 20 + [False]
+    assert len(v._cache) <= 8
+    # a key evicted by a reset and untouched since (key 1: loaded before
+    # the first clear, never re-seen) must still verify on re-sight —
+    # the reload-after-clear path, not a cache hit
+    assert items[1].pubkey not in v._cache
+    assert v.verify_batch([items[1]]) == [True]
