@@ -130,4 +130,3 @@ def test_key_cache_remap_across_calls():
     sd = bytes([42]) * 32
     sub.append(BatchItem(ref.public_key(sd), b"new", ref.sign(sd, b"new")))
     assert _native.verify_batch(sub) == [True, True, True]
-

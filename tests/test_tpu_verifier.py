@@ -214,20 +214,29 @@ def test_keybank_cap_falls_back_to_cpu():
     assert len(v._bank._index) == 2
 
 
-def test_meshed_tpu_verifier_fused():
+@pytest.mark.parametrize("packed", [False, True], ids=["dense", "packed"])
+def test_meshed_tpu_verifier_fused(packed):
     """TpuVerifier(mesh=...) fused mode: the GSPMD-sharded jit path (with
     its forced XLA accumulator — a Pallas call has no partitioning rule)
-    must agree with the oracle over the 8-device mesh."""
+    must agree with the oracle over the 8-device mesh, in both table-row
+    layouts (the table is replicated whatever its row width — this
+    pre-validates the default flip if the on-chip A/B favors packing)."""
     import jax
     from jax.sharding import Mesh
 
-    mesh = Mesh(np.asarray(jax.devices()[:8]), ("dp",))
-    v = TpuVerifier(mesh=mesh, mode="fused")
-    items = [_signed(i % 4, b"meshed %d" % i) for i in range(12)]
-    forged = BatchItem(items[0].pubkey, b"not the msg", items[0].sig)
-    items.append(forged)
-    oracle = [ref.verify(i.pubkey, i.msg, i.sig) for i in items]
-    assert v.verify_batch(items) == oracle == [True] * 12 + [False]
+    from simple_pbft_tpu.ops import comb
+
+    comb.use_row_packing(packed)
+    try:
+        mesh = Mesh(np.asarray(jax.devices()[:8]), ("dp",))
+        v = TpuVerifier(mesh=mesh, mode="fused")
+        items = [_signed(i % 4, b"meshed %d" % i) for i in range(12)]
+        forged = BatchItem(items[0].pubkey, b"not the msg", items[0].sig)
+        items.append(forged)
+        oracle = [ref.verify(i.pubkey, i.msg, i.sig) for i in items]
+        assert v.verify_batch(items) == oracle == [True] * 12 + [False]
+    finally:
+        comb.use_row_packing(False)
 
 
 def test_sharded_comb_quorum_step():
