@@ -89,7 +89,10 @@ async def _pump(client, stop_at: float, latencies: List[float], errors: List[int
     # under chaos can take ~45 s to drain its queue backlog, and a
     # request committed at t+45 whose replies are still in flight is a
     # tail latency sample, not a timeout.
-    retries = max(3, int(75.0 / max(client.request_timeout, 0.1)))
+    # retry COUNT derived from the patience budget under the client's
+    # backoff schedule (client.retries_for_patience): a fixed count
+    # would mean minutes of tail patience now that retries back off
+    retries = max(3, client.retries_for_patience(75.0))
     while time.perf_counter() < stop_at:
         t0 = time.perf_counter()
         try:
@@ -122,18 +125,57 @@ async def run_config(
     view_timeout: float = 0.0,
     chaos: dict = None,
     max_crashes: int = 3,
+    fault_spec: str = None,
+    verify_deadline: float = 60.0,
+    verify_max_pending: int = 65536,
 ) -> dict:
     from simple_pbft_tpu.committee import LocalCommittee
     from simple_pbft_tpu.crypto.coalesce import VerifyService
     from simple_pbft_tpu.crypto.tpu_verifier import TpuVerifier
+    from simple_pbft_tpu.faults import (
+        FaultInjector,
+        FaultSchedule,
+        SlowVerifier,
+        StallableDevice,
+    )
     from simple_pbft_tpu.transport.local import FaultPlan
 
+    # deterministic fault schedule (simple_pbft_tpu/faults.py): the
+    # chaos-on-TPU cell and the crash-count-matched storm A/B both key
+    # off --fault-schedule so a run's faults are a pure function of its
+    # seed — reproducible, host-independent, diffable between A/B arms
+    schedule = None
+    if fault_spec:
+        schedule = FaultSchedule.parse(
+            fault_spec, horizon=seconds,
+            replica_ids=[f"r{i}" for i in range(n)],
+        )
+
     factory = None
+    slow_wrap = None
     n_keys = n + n_clients + 8  # committee + clients + headroom
     if verifier == "insecure":
         from simple_pbft_tpu.crypto.verifier import InsecureVerifier
 
         factory = InsecureVerifier
+    if (
+        schedule
+        and verifier in ("cpu", "insecure")
+        and any(e.kind == "slow_verifier" for e in schedule.events)
+    ):
+        from simple_pbft_tpu.crypto.verifier import (
+            InsecureVerifier,
+            best_cpu_verifier,
+        )
+
+        # one shared slow-armable wrapper so the injector has a single
+        # seam; sharing a CPU verifier across replicas is safe (stateless
+        # beyond the process-wide row cache, which is already shared)
+        slow_wrap = SlowVerifier(
+            InsecureVerifier() if verifier == "insecure"
+            else best_cpu_verifier()
+        )
+        factory = lambda: slow_wrap  # noqa: E731
     if verifier == "tpu":
         import simple_pbft_tpu
 
@@ -157,7 +199,20 @@ async def run_config(
         # — n sequential tunnel RTTs per round becomes ~1
         # (crypto/coalesce.py; VERDICT r4 next #1).
         shared_verifier = TpuVerifier(initial_keys=n_keys)
-        service = VerifyService(shared_verifier)
+        device = shared_verifier
+        if schedule is not None:
+            # stall-injectable device front (faults.StallableDevice):
+            # dispatches stay fast, finishers block while stalled — the
+            # exact silent-tunnel shape the service watchdog guards
+            device = StallableDevice(shared_verifier)
+        # overload resilience (ISSUE 1): bounded admission + the
+        # dispatch-deadline watchdog with CPU failover + quarantine.
+        # --verify-deadline 0 disables the watchdog (pre-ISSUE-1 shape).
+        service = VerifyService(
+            device,
+            max_pending=verify_max_pending,
+            dispatch_deadline=verify_deadline if verify_deadline > 0 else None,
+        )
         factory = lambda: service  # noqa: E731
 
     plan = None
@@ -183,7 +238,7 @@ async def run_config(
         verifier_factory=factory,
         max_batch=batch,
         view_timeout=view_timeout
-        or (30.0 if not (storm or chaos) else degraded_vt),
+        or (30.0 if not (storm or chaos or schedule) else degraded_vt),
         checkpoint_interval=64,
         watermark_window=1024,
         qc_mode=qc_mode,
@@ -196,7 +251,7 @@ async def run_config(
         # lazy 30 s (which was the entire tail of every storm p99).
         # Clean steady-state benches keep the long timeout so retries
         # never distort throughput numbers.
-        degraded = storm or bool(chaos)
+        degraded = storm or bool(chaos) or schedule is not None
         c.request_timeout = (
             1.5 * (view_timeout or degraded_vt) if degraded else 30.0
         )
@@ -257,6 +312,17 @@ async def run_config(
         for _ in range(per_client)
     ]
 
+    injector = None
+    injector_task = None
+    if schedule is not None:
+        injector = FaultInjector(
+            committee=com,
+            schedule=schedule,
+            service=service if verifier == "tpu" else None,
+            slow=slow_wrap,
+        )
+        injector_task = asyncio.create_task(injector.run(stop_at))
+
     crash_info = {}
     if storm:
         # config 5: kill the primary mid-load REPEATEDLY; committee must
@@ -276,6 +342,9 @@ async def run_config(
         crash_info = {"primary_crashes": crashes}
 
     await asyncio.gather(*pumps, return_exceptions=True)
+    if injector_task is not None:
+        injector.stop()  # cancel pending window restores (they restore)
+        await asyncio.gather(injector_task, return_exceptions=True)
     elapsed = time.perf_counter() - t_start
     # throughput over the window; stragglers completing in the drain
     # tail still contribute their LATENCY samples below, honestly
@@ -295,6 +364,31 @@ async def run_config(
     replies_sent = sum(
         r.metrics.get("replies_sent", 0) for r in com.replicas if r._running
     )
+    # overload/degraded-mode evidence (ISSUE 1): how much inbound traffic
+    # the priority shed dropped, how many sweeps the verify service
+    # admission-rejected, and whether any replica is still flagged
+    # degraded at window end. Client-side: retransmissions vs requests
+    # that RECOVERED after a retry — the reconciliation for "unexplained
+    # client timeouts" (VERDICT r5 weak #3): a shed-then-recovered
+    # request now shows up here instead of vanishing into the timeout
+    # column.
+    shed_info = {
+        "messages_shed": sum(
+            r.metrics.get("messages_shed", 0) for r in com.replicas
+        ),
+        "sweeps_shed_overload": sum(
+            r.metrics.get("sweeps_shed_overload", 0) for r in com.replicas
+        ),
+        "degraded_replicas": sum(
+            1 for r in com.replicas if r.metrics.get("degraded_mode", 0)
+        ),
+        "client_retransmissions": sum(
+            c.metrics.get("retransmissions", 0) for c in com.clients
+        ),
+        "client_recovered_after_retry": sum(
+            c.metrics.get("recovered_after_retry", 0) for c in com.clients
+        ),
+    }
     if storm:
         # certificate-size evidence: the qc_mode claim is smaller failover
         # certificates — report the biggest ones actually built
@@ -343,6 +437,17 @@ async def run_config(
             svc_max_coalesced=service.max_coalesced,
             svc_submissions=service.coalesced_submissions,
             svc_rtt_ms_ema=round(service.rtt_ms, 1),
+            # overload-resilience evidence (ISSUE 1): bounded-admission
+            # pressure, watchdog activity, and CPU reroute volume — the
+            # post-mortem for any degraded window in this run
+            svc_degraded=service.degraded,
+            svc_max_pending_seen=service.max_pending_seen,
+            svc_overload_rejections=service.overload_rejections,
+            svc_watchdog_failovers=service.watchdog_failovers,
+            svc_quarantine_probes=service.quarantine_probes,
+            svc_cpu_reroute_passes=service.cpu_reroute_passes,
+            svc_cpu_reroute_items=service.cpu_reroute_items,
+            svc_late_device_completions=service.late_device_completions,
         )
 
     await com.stop()
@@ -386,8 +491,14 @@ async def run_config(
         "repliers_cfg": com.cfg.repliers,
         "vs_reference_req_s": round(committed / window / 0.4, 1),  # ref ~0.4/s
     }
+    rec.update(shed_info)
     rec.update(verify_stats)
     rec.update(crash_info)
+    if schedule is not None:
+        rec["faults"] = schedule.summary()
+        rec["faults_applied"] = injector.applied_count
+        rec["faults_skipped"] = injector.skipped
+        rec["fault_crashes"] = injector.crashes_applied
     return rec
 
 
@@ -415,6 +526,24 @@ async def main() -> None:
         "--chaos", default=None,
         help="fault injection for the run, e.g. drop=0.02,delay=0.03,"
         "dup=0.01,seed=42 (reproduces the committed soak numbers)",
+    )
+    ap.add_argument(
+        "--fault-schedule", default=None,
+        help="deterministic seeded fault schedule (simple_pbft_tpu/"
+        "faults.py), e.g. seed=42,crashes=3,drops=1,delays=1,stalls=1 — "
+        "the reproducible chaos/storm cell; crash counts here give the "
+        "crash-count-matched storm A/B (stalls need --verifier tpu)",
+    )
+    ap.add_argument(
+        "--verify-deadline", type=float, default=60.0,
+        help="tpu verify service: device dispatch deadline in seconds "
+        "before the watchdog fails the sweep over to the CPU verifier "
+        "and quarantines the device path (0 disables)",
+    )
+    ap.add_argument(
+        "--verify-max-pending", type=int, default=65536,
+        help="tpu verify service: pending-item cap; submits past it are "
+        "admission-rejected with Overloaded instead of queued",
     )
     ap.add_argument(
         "--view-timeout", type=float, default=0.0,
@@ -475,13 +604,18 @@ async def main() -> None:
                 f"runs via --storm over one of these committee sizes)"
             )
         cfg = ladder[key]
+        resilience = dict(
+            fault_spec=args.fault_schedule,
+            verify_deadline=args.verify_deadline,
+            verify_max_pending=args.verify_max_pending,
+        )
         if args.storm:
             rec = await run_config(
                 f"viewchange-storm-{cfg['name']}", cfg["n"], args.seconds,
                 args.clients, args.outstanding, args.verifier, args.batch,
                 storm=True, view_timeout=args.view_timeout,
                 qc_mode=cfg.get("qc_mode", False), chaos=chaos,
-                max_crashes=args.crashes,
+                max_crashes=args.crashes, **resilience,
             )
         else:
             rec = await run_config(
@@ -489,6 +623,7 @@ async def main() -> None:
                 args.outstanding, args.verifier, args.batch,
                 view_timeout=args.view_timeout,
                 qc_mode=cfg.get("qc_mode", False), chaos=chaos,
+                **resilience,
             )
         _emit(rec)
 

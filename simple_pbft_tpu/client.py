@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import random
 import time
 from collections import defaultdict
 from typing import Dict, Optional
@@ -45,6 +46,9 @@ class Client:
         verifier: Optional[Verifier] = None,
         request_timeout: float = 1.0,
         hedge: int = 0,
+        backoff_factor: float = 1.6,
+        backoff_cap: float = 0.0,
+        jitter: float = 0.1,
     ) -> None:
         self.id = client_id
         self.cfg = cfg
@@ -52,6 +56,24 @@ class Client:
         self.transport = transport
         self.verifier = verifier if verifier is not None else best_cpu_verifier()
         self.request_timeout = request_timeout
+        # Retry policy (ISSUE 1): attempt k waits request_timeout *
+        # backoff_factor**k (capped), +/- jitter fraction. Exponential
+        # backoff keeps a shedding committee from being re-flooded at a
+        # fixed cadence by every starving client at once (the r5 chaos
+        # cell's retry waves); jitter decorrelates the waves themselves.
+        # backoff_cap <= 0 means 8x the CURRENT request_timeout (benches
+        # mutate request_timeout after construction). factor 1.0 restores
+        # the old fixed-interval behavior exactly.
+        self.backoff_factor = backoff_factor
+        self.backoff_cap = backoff_cap
+        self.jitter = jitter
+        # deterministic per-client jitter stream: fault-injection runs
+        # replay identically for a given (client set, seed) pair
+        self._rng = random.Random(int.from_bytes(seed[:8], "big") ^ 0x5BD1)
+        # observability: retransmissions sent, requests that only
+        # completed after at least one retry (the "shed then recovered"
+        # signature — distinguishes overload shedding from real loss)
+        self.metrics: Dict[str, int] = defaultdict(int)
         # Hedged first send: also deliver each request to `hedge` backups
         # (rotating), who relay it to the primary and arm their failover
         # timers on first receipt. Kills the worst-case failover tail
@@ -204,8 +226,43 @@ class Client:
 
         task.add_done_callback(_consume)
 
+    def retries_for_patience(self, patience: float) -> int:
+        """Smallest retry count whose CUMULATIVE wait (backoff included,
+        jitter ignored) covers ``patience`` seconds. Benches size client
+        patience in wall-clock terms ("must outlast a 75 s failover
+        stall"); under exponential backoff a fixed retry COUNT would
+        silently mean minutes, not the intended budget."""
+        total, k = 0.0, 0
+        cap = self.backoff_cap if self.backoff_cap > 0 else (
+            8.0 * self.request_timeout
+        )
+        while total < patience and k < 1000:
+            total += min(cap, self.request_timeout * (self.backoff_factor ** k))
+            k += 1
+        return max(1, k - 1)  # k attempts = k-1 retries
+
+    def _attempt_timeout(self, attempt: int) -> float:
+        """Wait budget for retry ``attempt`` (0-based): exponential
+        backoff from request_timeout, capped, jittered. Monotone in
+        expectation — a request never waits LESS than the base timeout
+        minus jitter, so the f+1 collection window is never starved."""
+        cap = self.backoff_cap if self.backoff_cap > 0 else (
+            8.0 * self.request_timeout
+        )
+        t = min(cap, self.request_timeout * (self.backoff_factor ** attempt))
+        if self.jitter > 0:
+            t *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return t
+
     async def submit(self, operation: str, retries: int = 3) -> str:
         """Submit one operation; return the f+1-matched result.
+
+        Retransmissions are IDEMPOTENT by construction: every retry
+        re-sends the same signed (client_id, timestamp) request bytes, so
+        replicas dedup it server-side (cached-reply resend, never a
+        second execution) — a request shed under overload recovers on a
+        later attempt instead of becoming a timeout. Retries back off
+        exponentially with jitter (see __init__).
 
         Raises SupersededError if the committee reports the request's
         slot was folded under a checkpoint watermark (the op was not
@@ -242,12 +299,17 @@ class Client:
             for attempt in range(retries + 1):
                 try:
                     # a SupersededError set on the future raises here
-                    return await asyncio.wait_for(
-                        asyncio.shield(fut), self.request_timeout
+                    result = await asyncio.wait_for(
+                        asyncio.shield(fut), self._attempt_timeout(attempt)
                     )
+                    if attempt:
+                        self.metrics["recovered_after_retry"] += 1
+                    return result
                 except asyncio.TimeoutError:
                     if attempt == retries:
+                        self.metrics["request_timeouts"] += 1
                         raise
+                    self.metrics["retransmissions"] += 1
                     await self.transport.broadcast(raw, self.cfg.replica_ids)
             raise asyncio.TimeoutError  # pragma: no cover
         finally:

@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..app import Application, KVStore
 from ..config import CommitteeConfig
+from ..crypto.coalesce import Overloaded
 from ..crypto.signer import Signer
 from ..crypto.verifier import BatchItem, Verifier, best_cpu_verifier
 from ..logutil import ReplicaStats
@@ -89,6 +90,21 @@ RECENT_REPLIES_CAP = 512
 # request effectively never ages out.
 STALE_FOLD_INTERVALS = 16
 
+# Deferrable message classes for overload shedding (ISSUE 1 tentpole).
+# When a drain sweep exceeds the shed watermark the replica is behind
+# its inbound rate; ONLY these classes may be dropped — every sender
+# here has a retry path (clients back off and retransmit, fetch/probe
+# requesters re-fire on their own timers) — and they are shed BEFORE
+# their signatures are verified, since shedding after verify would
+# spend the very resource being protected. Everything else is treated
+# as quorum-critical by default (phase votes, checkpoints, view-change
+# traffic, QCs, and the BlockReply/StateResponse repair payloads whose
+# absence is usually the overload's cause): an unlisted class is KEPT —
+# the safe polarity for consensus liveness.
+SHED_DEFERRABLE = (
+    Request, SlotFetch, BlockFetch, StateRequest, NewViewFetch,
+)
+
 
 class Replica:
     """One PBFT replica: consensus state, execution, crypto seam."""
@@ -102,6 +118,7 @@ class Replica:
         app: Optional[Application] = None,
         verifier: Optional[Verifier] = None,
         max_drain: int = 4096,
+        shed_watermark: int = 0,
     ) -> None:
         self.id = node_id
         self.cfg = cfg
@@ -110,6 +127,11 @@ class Replica:
         self.app = app if app is not None else KVStore()
         self.verifier = verifier if verifier is not None else best_cpu_verifier()
         self.max_drain = max_drain
+        # overload shedding trips when a drain sweep exceeds this many
+        # decoded messages (0 = derive from max_drain: a sweep at 3/4 of
+        # the drain bound means the loop is running behind its inbound
+        # rate and deferrable classes must yield to quorum traffic)
+        self.shed_watermark = shed_watermark or max(64, (max_drain * 3) // 4)
 
         self.view = 0
         self.next_seq = 1  # primary's sequence allocator
@@ -445,6 +467,7 @@ class Replica:
                 decoded.append(Message.from_wire(raw))
             except ValueError:
                 self.metrics["malformed"] += 1
+        decoded = self._shed_for_overload(decoded)
         self.stats.sweep_size.record(len(sweep))
         spans: List[Tuple[int, int]] = []
         verify_task = None
@@ -472,6 +495,41 @@ class Replica:
                     )
             self.metrics["verified_sigs"] += len(items)
         return decoded, spans, verify_task
+
+    def _shed_for_overload(self, decoded: List[Message]) -> List[Message]:
+        """Priority-class load shedding (ISSUE 1 tentpole). A sweep past
+        the shed watermark means the replica is draining slower than
+        traffic arrives; processing everything would push verify latency
+        (and with it every quorum gate) unboundedly. Keep ALL
+        quorum-critical messages (pre-prepare/prepare/commit/checkpoint/
+        view-change/QC and requested repair payloads), fill the remaining
+        budget with deferrable ones (client requests, fetch/probe asks)
+        in arrival order, and drop the rest — every dropped class has a
+        sender-side retry (client backoff rebroadcast, probe re-fire), so
+        shedding converts unbounded latency into bounded retries. The
+        degraded_mode metric is a level, not a counter: 1 while shedding,
+        back to 0 on the first comfortable sweep."""
+        if len(decoded) <= self.shed_watermark:
+            if self.metrics.get("degraded_mode") and (
+                len(decoded) <= self.shed_watermark // 2
+            ):
+                self.metrics["degraded_mode"] = 0
+            return decoded
+        critical = [m for m in decoded if not isinstance(m, SHED_DEFERRABLE)]
+        budget = max(0, self.shed_watermark - len(critical))
+        kept = critical
+        deferred = [m for m in decoded if isinstance(m, SHED_DEFERRABLE)]
+        if budget:
+            # arrival order preserved within the class; the merge below
+            # keeps overall order too (stable filter + index sort)
+            kept = critical + deferred[:budget]
+            order = {id(m): i for i, m in enumerate(decoded)}
+            kept.sort(key=lambda m: order[id(m)])
+        shed = len(decoded) - len(kept)
+        if shed:
+            self.metrics["messages_shed"] += shed
+            self.metrics["degraded_mode"] = 1
+        return kept
 
     def _cache_filter(self, items: List[BatchItem]):
         """Split a sweep's items into cache hits (already-verified-good)
@@ -558,7 +616,18 @@ class Replica:
         t0 = time.perf_counter()
         accepted = decoded
         if self.cfg.verify_signatures:
-            bitmap = await verify_task if verify_task is not None else []
+            try:
+                bitmap = await verify_task if verify_task is not None else []
+            except Overloaded:
+                # the verify service admission-rejected this sweep: shed
+                # it whole. Every sender has a retry path (clients back
+                # off and rebroadcast, peers' probes re-fire), so the
+                # work recovers once the pile drains — meanwhile this
+                # replica must not queue more verify demand.
+                self.metrics["sweeps_shed_overload"] += 1
+                self.metrics["messages_shed"] += len(decoded)
+                self.metrics["degraded_mode"] = 1
+                return
             accepted = []
             for msg, (s, e) in zip(decoded, spans):
                 if s == e:

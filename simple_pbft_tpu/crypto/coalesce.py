@@ -42,6 +42,17 @@ from typing import List, Optional, Sequence
 from .verifier import BatchItem, Verifier, best_cpu_verifier
 
 
+class Overloaded(RuntimeError):
+    """Admission-rejected submit: the service's pending pile is at cap.
+
+    Raised (as the future's exception) instead of queueing when accepting
+    the batch would grow the pending pile past ``max_pending``. The round-5
+    qc256 wedge showed what unbounded admission does under sustained
+    submit-rate > drain-rate: svc_rtt_ms_ema ~15,000 ms and a 25-minute
+    run with zero commits. Rejecting loudly lets the submitter shed the
+    sweep (peers/clients retransmit) while the pile stays bounded."""
+
+
 class VerifyService:
     """Coalescing front for a device verifier + CPU small-batch path.
 
@@ -66,14 +77,39 @@ class VerifyService:
         cpu: Optional[Verifier] = None,
         max_batch: int = 8192,
         cpu_cutoff: Optional[int] = None,
+        max_pending: int = 65536,
+        dispatch_deadline: Optional[float] = None,
+        quarantine_base: float = 1.0,
+        quarantine_cap: float = 60.0,
     ):
         # public: callers (benches, deployment tests) reach through to
         # the device verifier's bank/counters for contract checks
         self.device = self._device = device
+        # NOTE: the watchdog/quarantine reroutes verify device-destined
+        # piles on this same CPU backend. On hosts where best_cpu_verifier
+        # is NativeEdVerifier that is kernel-equivalent; where it falls
+        # back to OpenSSL, edge-vector verdicts can differ from the
+        # kernel's — the same cross-pile property the size-routed CPU
+        # path already has on such hosts. Deliberate: the failover path
+        # exists to restore liveness, and the strict pure-Python oracle
+        # is ~3 orders of magnitude slower — swapping it in would re-wedge
+        # exactly the runs the watchdog rescues. Pass a strict `cpu` to
+        # get full verdict uniformity at that price.
         self._cpu = cpu if cpu is not None else best_cpu_verifier()
         self._max_batch = max_batch
         # fixed cutoff if given; else adaptive from the measured rates
         self._fixed_cutoff = cpu_cutoff
+        # bounded admission: pending items beyond this cap are rejected
+        # with Overloaded instead of queued (RTT must stay bounded)
+        self._max_pending = max_pending
+        # device-stall watchdog: a dispatch whose result does not land
+        # within this many seconds is failed over to the CPU verifier
+        # and the device path quarantined (None = watchdog off)
+        self._deadline = dispatch_deadline
+        self._quarantine_base = quarantine_base
+        self._quarantine_cap = quarantine_cap
+        self._quarantined_until = 0.0  # monotonic; 0 = healthy
+        self._quarantine_backoff = quarantine_base
         self._pending: deque = deque()  # (items, future)
         self._pending_items = 0
         self._cond = threading.Condition()
@@ -96,12 +132,34 @@ class VerifyService:
         self.cpu_pass_items = 0
         self.max_coalesced = 0
         self.coalesced_submissions = 0
+        self.max_pending_seen = 0
+        self.overload_rejections = 0
+        self.overload_rejected_items = 0
+        self.watchdog_failovers = 0
+        self.quarantine_probes = 0
+        self.cpu_reroute_passes = 0
+        self.cpu_reroute_items = 0
+        self.late_device_completions = 0
 
     @property
     def rtt_ms(self) -> float:
         """Smoothed dispatch->result latency of a device pass, ms (the
         public face of the adaptive estimate the cutoff policy uses)."""
         return self._rtt_ema * 1e3
+
+    @property
+    def quarantined(self) -> bool:
+        """True while the device path is benched after a watchdog trip
+        (all routing goes to the CPU verifier until the re-probe timer
+        expires)."""
+        return time.monotonic() < self._quarantined_until
+
+    @property
+    def degraded(self) -> bool:
+        """Overload-resilience summary flag: the service is currently
+        shedding (quarantined device) or has ever rejected for overload
+        — surfaced in bench/metrics dumps so a degraded run is visible."""
+        return self.quarantined or self.overload_rejections > 0
 
     # -- Verifier-protocol pass-throughs ---------------------------------
 
@@ -144,14 +202,39 @@ class VerifyService:
         if not items:
             fut.set_result([])
             return fut
+        rejected = False
         with self._cond:
             closed = self._closed
             if not closed:
-                if not self._started:
-                    self._start_threads()
-                self._pending.append((list(items), fut))
-                self._pending_items += len(items)
-                self._cond.notify_all()
+                # Bounded admission: a pile past max_pending means drain
+                # rate lost to submit rate — queuing more only grows RTT
+                # without bound (the r5 qc256 wedge shape). Reject loudly;
+                # the submitter sheds the sweep and its senders retry.
+                if (
+                    self._pending_items + len(items) > self._max_pending
+                    and self._pending_items > 0
+                ):
+                    rejected = True
+                else:
+                    if not self._started:
+                        self._start_threads()
+                    self._pending.append((list(items), fut))
+                    self._pending_items += len(items)
+                    if self._pending_items > self.max_pending_seen:
+                        self.max_pending_seen = self._pending_items
+                    self._cond.notify_all()
+        if rejected:
+            # outside the lock: counters are plain ints (GIL-atomic) and
+            # the future's waiter may run callbacks inline
+            self.overload_rejections += 1
+            self.overload_rejected_items += len(items)
+            fut.set_exception(
+                Overloaded(
+                    f"verify service overloaded: {self._pending_items} "
+                    f"items pending (cap {self._max_pending})"
+                )
+            )
+            return fut
         if closed:
             # teardown race (a replica's last sweep vs the bench closing
             # the service): answer on the CPU path rather than erroring a
@@ -218,6 +301,8 @@ class VerifyService:
         path clears them in ~1 ms while the device absorbs the bulk."""
         if not self._pending:
             return False
+        if self.quarantined:
+            return True  # everything drains on the CPU path right now
         if self._pending_items <= self._cutoff():
             return True  # CPU path (or a free device slot) is immediate
         if self._inflight >= self.MAX_DEPTH:
@@ -257,11 +342,23 @@ class VerifyService:
                 # exceeding MAX_DEPTH instead (a dispatch-overlap policy,
                 # not a correctness bound; the verifier serializes device
                 # access itself).
-                route_cpu = total <= self._cutoff() or (
+                # Quarantine overrides size routing: after a watchdog
+                # trip EVERYTHING drains on the CPU until the re-probe
+                # backoff expires; the first post-backoff big pile is the
+                # probe that decides whether the device is back.
+                quarantined = self.quarantined
+                route_cpu = quarantined or total <= self._cutoff() or (
                     self._fixed_cutoff is None
                     and self._inflight >= self.MAX_DEPTH
                 )
                 if not route_cpu:
+                    if (
+                        self._deadline is not None
+                        and self._quarantine_backoff > self._quarantine_base
+                    ):
+                        # backoff expired and we are about to touch the
+                        # device again: this dispatch is the re-probe
+                        self.quarantine_probes += 1
                     self._inflight += 1
             batch: List[BatchItem] = []
             for items, _fut in subs:
@@ -269,7 +366,22 @@ class VerifyService:
             self.coalesced_submissions += len(subs)
             self.max_coalesced = max(self.max_coalesced, total)
             if route_cpu:
-                self._run_cpu(batch, subs)
+                if quarantined and total > self._cutoff():
+                    # big pile reforced onto the CPU by quarantine: run it
+                    # on its own thread so the dispatch loop keeps
+                    # clearing small quorum sweeps — per-pile latency
+                    # isolation, a multi-thousand-item reroute must never
+                    # serialize a 15-item quorum gate behind it
+                    self.cpu_reroute_passes += 1
+                    self.cpu_reroute_items += total
+                    threading.Thread(
+                        target=self._run_cpu,
+                        args=(batch, subs),
+                        name="verify-cpu-reroute",
+                        daemon=True,
+                    ).start()
+                else:
+                    self._run_cpu(batch, subs)
             else:
                 t0 = time.perf_counter()
                 try:
@@ -294,7 +406,20 @@ class VerifyService:
                     return
                 finisher, subs, t0, total = entry
             try:
-                verdicts = finisher()
+                if self._deadline is not None:
+                    verdicts = self._finish_with_deadline(
+                        finisher, subs, t0, total
+                    )
+                    if verdicts is None:
+                        # watchdog fired: the pile was already failed over
+                        # to the CPU and the device quarantined — only the
+                        # in-flight slot remains to release
+                        with self._cond:
+                            self._inflight -= 1
+                            self._cond.notify_all()
+                        continue
+                else:
+                    verdicts = finisher()
             except BaseException as e:  # noqa: BLE001
                 self._fail(subs, e)
             else:
@@ -303,9 +428,85 @@ class VerifyService:
                 self.device_passes += 1
                 self.device_pass_items += total
                 self._resolve(subs, verdicts)
+                # a completed pass within deadline is proof of device
+                # health: end any quarantine and reset the re-probe ladder
+                self._quarantined_until = 0.0
+                self._quarantine_backoff = self._quarantine_base
             with self._cond:
                 self._inflight -= 1
                 self._cond.notify_all()
+
+    def _finish_with_deadline(self, finisher, subs, t0, total):
+        """Run ``finisher`` on a sidecar thread and wait at most the
+        configured deadline (measured from DISPATCH, so time already
+        spent queued behind an earlier stuck pass counts). On expiry:
+        fail the pile over to the CPU verifier on ITS OWN thread (a big
+        stuck pile must not block later small piles' completions through
+        this loop), quarantine the device path with exponential re-probe
+        backoff, and abandon the stuck finisher (daemon thread). Returns
+        the verdicts, or None when the watchdog fired; device exceptions
+        re-raise exactly like the undeadlined path."""
+        # per-pass sidecar thread: ~100 us of spawn cost against device
+        # passes that are tens of ms (tunneled: up to seconds) — noise.
+        # A persistent watcher would save it at the price of lifecycle
+        # state shared with the abandon path; not worth it at this RTT.
+        box: dict = {}
+        done = threading.Event()
+
+        def run() -> None:
+            try:
+                box["r"] = finisher()
+            except BaseException as e:  # noqa: BLE001
+                box["e"] = e
+            done.set()
+            if "late" in box and "r" in box:
+                # the stalled call eventually landed AFTER failover: the
+                # verdicts are discarded (the CPU already answered) but a
+                # successful late landing is evidence the device lives —
+                # lift the quarantine early
+                self.late_device_completions += 1
+                self._quarantined_until = 0.0
+
+        t = threading.Thread(target=run, name="verify-finish", daemon=True)
+        t.start()
+        remaining = self._deadline - (time.perf_counter() - t0)
+        if done.wait(max(0.0, remaining)):
+            if "e" in box:
+                raise box["e"]
+            return box["r"]
+        # deadline exceeded: this is the stalled-device shape (r5 qc256:
+        # svc_rtt_ms_ema ~15 s, one 25-minute wedge). Quarantine first so
+        # the dispatch loop reroutes everything still pending, THEN
+        # rescue this pile on the CPU.
+        box["late"] = True  # benign race with done.set(): see below
+        self.watchdog_failovers += 1
+        now = time.monotonic()
+        self._quarantined_until = now + self._quarantine_backoff
+        self._quarantine_backoff = min(
+            self._quarantine_cap, self._quarantine_backoff * 2
+        )
+        with self._cond:
+            self._cond.notify_all()  # wake dispatch: routing just changed
+        if done.is_set():
+            # the finisher landed in the instant between wait() expiry
+            # and the late-marker: its result is still good — use it and
+            # withdraw the quarantine we just armed
+            self._quarantined_until = 0.0
+            if "e" in box:
+                raise box["e"]
+            return box["r"]
+        batch: List[BatchItem] = []
+        for items, _fut in subs:
+            batch.extend(items)
+        self.cpu_reroute_passes += 1
+        self.cpu_reroute_items += total
+        threading.Thread(
+            target=self._run_cpu,
+            args=(batch, subs),
+            name="verify-watchdog-failover",
+            daemon=True,
+        ).start()
+        return None
 
     def _run_cpu(self, batch: List[BatchItem], subs) -> None:
         t0 = time.perf_counter()

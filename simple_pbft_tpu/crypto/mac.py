@@ -38,15 +38,34 @@ def _kx_priv_bytes(seed: bytes) -> bytes:
     return hashlib.sha256(_KX_DOMAIN + seed).digest()
 
 
-def kx_pubkey(seed: bytes) -> bytes:
-    """32-byte X25519 public key for a node's key-exchange identity."""
-    from cryptography.hazmat.primitives.asymmetric.x25519 import (
-        X25519PrivateKey,
-    )
-    from cryptography.hazmat.primitives.serialization import (
-        Encoding,
-        PublicFormat,
-    )
+def kx_available() -> bool:
+    """Is the X25519 backend (the `cryptography` wheel) importable? The
+    MAC fast path is an OPTIONAL optimization: every caller must degrade
+    to Ed25519-signed replies when this is False, never crash."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric import (  # noqa: F401
+            x25519,
+        )
+
+        return True
+    except ImportError:
+        return False
+
+
+def kx_pubkey(seed: bytes) -> Optional[bytes]:
+    """32-byte X25519 public key for a node's key-exchange identity, or
+    None when no X25519 backend is available (the node then publishes no
+    kx key and all its replies are Ed25519-signed)."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric.x25519 import (
+            X25519PrivateKey,
+        )
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            PublicFormat,
+        )
+    except ImportError:
+        return None
 
     priv = X25519PrivateKey.from_private_bytes(_kx_priv_bytes(seed))
     return priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
@@ -54,16 +73,16 @@ def kx_pubkey(seed: bytes) -> bytes:
 
 def shared_key(seed: bytes, peer_kx_pub: bytes) -> Optional[bytes]:
     """HKDF-extracted 32-byte MAC key for (this node, peer). None if the
-    peer key is structurally invalid."""
-    from cryptography.hazmat.primitives.asymmetric.x25519 import (
-        X25519PrivateKey,
-        X25519PublicKey,
-    )
-
+    peer key is structurally invalid or no X25519 backend exists."""
     try:
+        from cryptography.hazmat.primitives.asymmetric.x25519 import (
+            X25519PrivateKey,
+            X25519PublicKey,
+        )
+
         priv = X25519PrivateKey.from_private_bytes(_kx_priv_bytes(seed))
         secret = priv.exchange(X25519PublicKey.from_public_bytes(peer_kx_pub))
-    except Exception:  # malformed peer key: caller falls back to signatures
+    except Exception:  # malformed peer key / no backend: fall back to sigs
         return None
     return hmac.new(_KX_DOMAIN, secret, hashlib.sha256).digest()
 

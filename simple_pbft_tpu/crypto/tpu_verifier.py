@@ -773,9 +773,17 @@ class TpuVerifier:
                 self.device_seconds += time.perf_counter() - t0
             if fallback:
                 if self._cpu_fb is None:
-                    from .verifier import best_cpu_verifier
+                    from .verifier import kernel_equivalent_cpu_verifier
 
-                    self._cpu_fb = best_cpu_verifier()
+                    # kernel-EQUIVALENT only (native batched Ed25519,
+                    # else the RFC 8032 oracle — never OpenSSL): the
+                    # fallback rows share a verdict bitmap with kernel
+                    # rows, so the two accept/reject sets must agree on
+                    # every edge vector (non-canonical R/S, off-curve
+                    # points) or a crafted signature splits the pile
+                    # (ADVICE r5; agreement pinned by
+                    # test_overbank_fallback_agrees_with_kernel)
+                    self._cpu_fb = kernel_equivalent_cpu_verifier()
                 # keys over the bank cap: ONE batched native-CPU pass,
                 # not a scalar loop — at n=256 the over-cap keys were
                 # the clients', i.e. most of the pile, and the

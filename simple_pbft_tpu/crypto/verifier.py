@@ -61,8 +61,11 @@ class OpenSSLVerifier:
     MAX_KEYS = 8192  # parsed-key cache bound: an adversarial client
     # spraying fresh valid curve points must not grow host memory
     # without bound (same rationale as NativeEdVerifier.MAX_KEYS; this
-    # verifier also serves as the TpuVerifier's over-bank-cap fallback,
-    # which sees exactly that traffic shape)
+    # verifier also sees exactly that traffic shape as a CPU fallback).
+    # At cap the cache STOPS INSERTING rather than clearing (ADVICE r5):
+    # committee keys land early and stay resident, so adversarial
+    # fresh-key churn costs the ATTACKER's items a parse each, never a
+    # committee-wide cold restart — mirroring NativeEdVerifier._row_for.
 
     def __init__(self) -> None:
         from cryptography.hazmat.primitives.asymmetric.ed25519 import (
@@ -81,10 +84,8 @@ class OpenSSLVerifier:
                 pk = self._cache.get(it.pubkey)
                 if pk is None:
                     pk = self._load(it.pubkey)
-                    if len(self._cache) >= self.MAX_KEYS:
-                        self._cache.clear()  # rare full reset beats LRU
-                        # bookkeeping on this hot path
-                    self._cache[it.pubkey] = pk
+                    if len(self._cache) < self.MAX_KEYS:
+                        self._cache[it.pubkey] = pk
                 pk.verify(it.sig, it.msg)
                 out.append(True)
             except Exception:
@@ -219,6 +220,22 @@ def best_cpu_verifier() -> Verifier:
     try:
         return OpenSSLVerifier()
     except ImportError:  # pragma: no cover
+        return CpuVerifier()
+
+
+def kernel_equivalent_cpu_verifier() -> Verifier:
+    """Fastest CPU backend whose accept/reject set MATCHES the TPU
+    kernel bit-for-bit: NativeEdVerifier, else the RFC 8032 oracle —
+    never OpenSSL. The kernel is cofactorless and strict (non-canonical
+    or off-curve R never matches; S >= L rejects); OpenSSL's Ed25519
+    differs on exactly those edge vectors, so using it where a verdict
+    must agree with the kernel (the TpuVerifier's over-bank-cap
+    fallback: one BATCH split between kernel and fallback) would let a
+    crafted signature verify on some items of a pile and not others —
+    a committee-splitting primitive (ADVICE r5)."""
+    try:
+        return NativeEdVerifier()
+    except ImportError:
         return CpuVerifier()
 
 

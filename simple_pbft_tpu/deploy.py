@@ -81,14 +81,17 @@ def generate(
             "host": host,
             "port": port,
             "pubkey": kp.pub.hex(),
+        }
+        if kx is not None:
             # X25519 key-exchange pubkey: enables MAC'd replies (the
             # point-to-point fast path, crypto/mac.py); derived from the
-            # same seed so the per-node secret material stays one file
-            "kx_pubkey": kx.hex(),
-        }
+            # same seed so the per-node secret material stays one file.
+            # Omitted when no X25519 backend exists — replies then fall
+            # back to Ed25519 signatures (mac.kx_available).
+            doc[kind][name]["kx_pubkey"] = kx.hex()
+            kx_pubkeys[name] = kx
         addresses[name] = (host, port)
         pubkeys[name] = kp.pub
-        kx_pubkeys[name] = kx
     with open(os.path.join(out_dir, "committee.json"), "w") as fh:
         json.dump(doc, fh, indent=2, sort_keys=True)
     cfg = CommitteeConfig(

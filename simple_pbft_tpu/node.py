@@ -36,13 +36,26 @@ def make_transport(name: str, node_id: str, dep: "deploy.Deployment"):
     )
 
 
-def make_verifier(name: str, dep=None):
+def make_verifier(
+    name: str,
+    dep=None,
+    verify_max_pending: int = 65536,
+    verify_deadline: float = 60.0,
+):
     if name == "tpu":
         from .crypto.coalesce import VerifyService
         from .crypto.tpu_verifier import TpuVerifier
 
+        # overload knobs (docs/RESILIENCE.md): bounded admission rejects
+        # with Overloaded past max_pending; the dispatch-deadline
+        # watchdog fails a stalled device sweep over to the CPU verifier
+        # and quarantines the device path (deadline <= 0 disables it)
+        svc_kw = dict(
+            max_pending=verify_max_pending,
+            dispatch_deadline=verify_deadline if verify_deadline > 0 else None,
+        )
         if dep is None:
-            return VerifyService(TpuVerifier())
+            return VerifyService(TpuVerifier(), **svc_kw)
         # Size the key bank to the deployment's published key population
         # and pre-pay the device compiles before serving traffic: the
         # jit signature includes the table shape, so a bank growing
@@ -56,7 +69,8 @@ def make_verifier(name: str, dep=None):
         return VerifyService(
             TpuVerifier.for_population(
                 list(dep.cfg.pubkeys.values()), max_sweep=4096
-            )
+            ),
+            **svc_kw,
         )
     if name == "cpu":
         return best_cpu_verifier()
@@ -77,7 +91,14 @@ async def run_node(args) -> None:
         cfg=dep.cfg,
         seed=seed,
         transport=transport,
-        verifier=make_verifier(args.verifier, dep),
+        verifier=make_verifier(
+            args.verifier,
+            dep,
+            verify_max_pending=args.verify_max_pending,
+            verify_deadline=args.verify_deadline,
+        ),
+        max_drain=args.max_drain,
+        shed_watermark=args.shed_watermark,
     )
     replica.start()
     logging.info(
@@ -96,6 +117,24 @@ async def run_node(args) -> None:
     # line — the observability the perf work steers by (VERDICT weak #8)
     logging.info("%s: stats %s", args.id, replica.stats.dump(replica.metrics))
     logging.info("%s: transport %s", args.id, dict(transport.metrics))
+    svc = replica.verifier
+    if hasattr(svc, "overload_rejections"):
+        # overload-resilience counters (crypto/coalesce.py): was this run
+        # ever shedding, did the device watchdog fire, how deep did the
+        # pending pile get — the post-mortem for any degraded window
+        logging.info(
+            "%s: verify service %s",
+            args.id,
+            dict(
+                degraded=svc.degraded,
+                max_pending_seen=svc.max_pending_seen,
+                overload_rejections=svc.overload_rejections,
+                watchdog_failovers=svc.watchdog_failovers,
+                quarantine_probes=svc.quarantine_probes,
+                cpu_reroute_passes=svc.cpu_reroute_passes,
+                late_device_completions=svc.late_device_completions,
+            ),
+        )
 
 
 def main() -> None:
@@ -117,6 +156,28 @@ def main() -> None:
         default="tcp",
         choices=["tcp", "grpc"],
         help="wire transport (grpc = HTTP/2 streams, the DCN path)",
+    )
+    ap.add_argument(
+        "--max-drain", type=int, default=4096,
+        help="max messages drained per sweep (inbound batch bound)",
+    )
+    ap.add_argument(
+        "--shed-watermark", type=int, default=0,
+        help="decoded-sweep size beyond which deferrable message classes "
+        "(client requests, fetch/probe asks) are shed in favor of "
+        "quorum-critical traffic; 0 = 3/4 of --max-drain "
+        "(docs/RESILIENCE.md)",
+    )
+    ap.add_argument(
+        "--verify-max-pending", type=int, default=65536,
+        help="tpu verifier: pending-item cap before submits are "
+        "admission-rejected with Overloaded (bounded queue depth)",
+    )
+    ap.add_argument(
+        "--verify-deadline", type=float, default=60.0,
+        help="tpu verifier: seconds a device dispatch may run before the "
+        "watchdog fails the sweep over to the CPU verifier and "
+        "quarantines the device path (0 disables)",
     )
     ap.add_argument("--log-level", default="INFO")
     ap.add_argument(

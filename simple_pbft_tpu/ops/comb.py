@@ -280,7 +280,15 @@ def negate_rows(rows: jnp.ndarray) -> jnp.ndarray:
     """Niels negation on packed rows: swap (y+x, y−x), negate 2dxy.
     Dense layout only — the separate-table comb path that needs it never
     runs packed (use_row_packing gates the fused path's tables)."""
-    assert not PACKED, "negate_rows is a dense-layout (comb-mode) helper"
+    if PACKED:
+        # unconditional (NOT an assert): under `python -O` a packed
+        # table silently negated with dense-layout arithmetic would
+        # produce wrong group elements — and wrong verify verdicts —
+        # instead of failing loudly (ADVICE r5)
+        raise RuntimeError(
+            "negate_rows is a dense-layout (comb-mode) helper; "
+            "packed rows (use_row_packing) only feed the fused path"
+        )
     ypx, ymx, xy2d = _row_niels(rows)
     return jnp.concatenate(
         [ymx, ypx, fe.neg(xy2d), rows[3 * fe.NLIMB :]], axis=0

@@ -128,6 +128,13 @@ def test_macbank_unknown_peer_not_cached():
     for i in range(100):
         assert bank.key_for(f"evil{i}") is None
     assert len(bank._keys) == 0  # misses never cached
+    from simple_pbft_tpu.crypto import mac as mac_mod
+
+    if not mac_mod.kx_available():
+        # no X25519 backend: the committee publishes no kx keys and every
+        # reply falls back to Ed25519 signatures — the known-peer half of
+        # this test has nothing to exercise
+        pytest.skip("cryptography wheel absent: MAC fast path disabled")
     known = bank.key_for("r0")
     assert known is not None and len(bank._keys) == 1
 

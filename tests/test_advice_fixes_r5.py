@@ -1,0 +1,144 @@
+"""Round-5 ADVICE satellite fixes (ISSUE 1).
+
+- OpenSSLVerifier's parsed-key cache stops inserting at MAX_KEYS instead
+  of clearing: committee keys stay resident under adversarial fresh-key
+  churn (mirrors NativeEdVerifier._row_for's policy).
+- ops/comb.negate_rows fails loudly with RuntimeError (not a stripped
+  assert) when called on packed-layout tables.
+- chip_daemon logs each malformed queue-override spec once per file
+  version, not once per queue poll.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+# ---------------------------------------------------------------------------
+# OpenSSLVerifier key-cache policy
+# ---------------------------------------------------------------------------
+
+
+class _FakeParsedKey:
+    def __init__(self, raw):
+        self.raw = raw
+
+    def verify(self, sig, msg):
+        if sig != msg:
+            raise ValueError("bad")
+
+
+def _openssl_with_fake_loader(max_keys):
+    """OpenSSLVerifier with the `cryptography` loader mocked so the
+    cache POLICY is testable on hosts without the wheel (this container:
+    the wheel is absent and the real __init__ would ImportError)."""
+    from simple_pbft_tpu.crypto.verifier import BatchItem, OpenSSLVerifier
+
+    v = OpenSSLVerifier.__new__(OpenSSLVerifier)
+    loads = []
+
+    def load(raw):
+        loads.append(raw)
+        return _FakeParsedKey(raw)
+
+    v._load = load
+    v._cache = {}
+    v.MAX_KEYS = max_keys
+    return v, loads, BatchItem
+
+
+def test_openssl_cache_stops_inserting_at_cap_keeps_committee_keys():
+    v, loads, BatchItem = _openssl_with_fake_loader(max_keys=4)
+    committee = [bytes([i]) * 32 for i in range(4)]
+    # committee keys land early and fill the cache
+    v.verify_batch([BatchItem(pk, b"m", b"m") for pk in committee])
+    assert sorted(v._cache) == sorted(committee)
+    # adversarial churn: 50 fresh keys — none may enter, none may evict
+    churn = [bytes([100 + i]) * 32 for i in range(50)]
+    out = v.verify_batch([BatchItem(pk, b"m", b"m") for pk in churn])
+    assert out == [True] * 50  # still verified, just not cached
+    assert sorted(v._cache) == sorted(committee)  # keys stayed resident
+    # committee traffic after the storm: zero new parses (cache hits)
+    n_loads = len(loads)
+    v.verify_batch([BatchItem(pk, b"m2", b"m2") for pk in committee])
+    assert len(loads) == n_loads
+
+
+def test_openssl_cache_churn_costs_attacker_not_committee():
+    v, loads, BatchItem = _openssl_with_fake_loader(max_keys=2)
+    a, b = b"\x01" * 32, b"\x02" * 32
+    v.verify_batch([BatchItem(a, b"m", b"m"), BatchItem(b, b"m", b"m")])
+    # the same over-cap key re-parses per batch (bounded memory), the
+    # resident keys never do
+    evil = b"\xee" * 32
+    for _ in range(3):
+        v.verify_batch([BatchItem(evil, b"m", b"m"), BatchItem(a, b"m", b"m")])
+    assert loads.count(evil) == 3
+    assert loads.count(a) == 1
+
+
+# ---------------------------------------------------------------------------
+# comb.negate_rows packed-layout guard
+# ---------------------------------------------------------------------------
+
+
+def test_negate_rows_raises_runtime_error_on_packed_layout():
+    """Must be an unconditional RuntimeError: under `python -O` a bare
+    assert would vanish and packed tables would be dense-negated into
+    wrong group elements (wrong verify verdicts) silently."""
+    from simple_pbft_tpu.ops import comb
+
+    comb.use_row_packing(True)
+    try:
+        with pytest.raises(RuntimeError, match="dense-layout"):
+            comb.negate_rows(np.zeros((comb.ROW, 2), dtype=np.int32))
+    finally:
+        comb.use_row_packing(False)
+    # dense layout still works (shape sanity only; numeric behavior is
+    # covered by the kernel-vs-oracle suites)
+    rows = np.asarray(comb.base_table())
+    assert comb.negate_rows(rows).shape == rows.shape
+
+
+# ---------------------------------------------------------------------------
+# chip_daemon: malformed override spec logs once per file version
+# ---------------------------------------------------------------------------
+
+
+def test_override_spec_logged_once_per_file_version(tmp_path, monkeypatch):
+    import chip_daemon
+
+    override = tmp_path / "chip_queue_test.json"
+    logged = []
+    monkeypatch.setattr(chip_daemon, "QUEUE_OVERRIDE", str(override))
+    monkeypatch.setattr(chip_daemon, "_log", lambda msg: logged.append(msg))
+    chip_daemon._override_complained.clear()
+
+    # one good spec + one malformed (args not a list)
+    override.write_text(json.dumps([
+        {"exp": "ok_exp", "kind": "consensus", "args": ["--configs", "1"]},
+        {"exp": "bad_exp", "kind": "consensus", "args": "not-a-list"},
+    ]))
+    for _ in range(5):  # five queue polls
+        out = chip_daemon._override_experiments()
+        assert [e["exp"] for e in out] == ["ok_exp"]
+    assert len(logged) == 1  # malformed spec complained about ONCE
+    assert "bad_exp" in logged[0]
+
+    # editing the file re-arms the complaint (new version, new log line)
+    os.utime(override, (1, 1))  # distinct mtime stamp
+    chip_daemon._override_experiments()
+    assert len(logged) == 2
+
+    # unreadable file: same once-per-version rule
+    override.write_text("{not json")
+    chip_daemon._override_experiments()
+    chip_daemon._override_experiments()
+    assert len(logged) == 3
+    assert "unreadable" in logged[2]

@@ -1,0 +1,252 @@
+"""Deterministic fault injection (simple_pbft_tpu/faults.py): schedule
+determinism, CLI-spec parsing, the verifier-seam wrappers, and injector
+semantics (quorum floor, window restore)."""
+
+import asyncio
+import time
+
+import pytest
+
+from simple_pbft_tpu.committee import LocalCommittee
+from simple_pbft_tpu.faults import (
+    FaultEvent,
+    FaultInjector,
+    FaultSchedule,
+    SlowVerifier,
+    StallableDevice,
+)
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+# ---------------------------------------------------------------------------
+# schedule determinism (the acceptance-criteria replay property)
+# ---------------------------------------------------------------------------
+
+
+def test_same_seed_replays_identically():
+    """The core reproducibility contract: generate() is a pure function
+    of its arguments — same seed, same schedule, byte for byte."""
+    kw = dict(
+        horizon=30.0, crashes=3, drop_windows=2, delay_windows=1,
+        slow_verifier_windows=1, device_stalls=2,
+        replica_ids=[f"r{i}" for i in range(16)],
+    )
+    a = FaultSchedule.generate(seed=42, **kw)
+    b = FaultSchedule.generate(seed=42, **kw)
+    assert a == b
+    assert a.events == b.events
+    assert [e.to_dict() for e in a.events] == [e.to_dict() for e in b.events]
+    # and a different seed actually differs
+    c = FaultSchedule.generate(seed=43, **kw)
+    assert c.events != a.events
+
+
+def test_schedule_shape_and_bounds():
+    s = FaultSchedule.generate(
+        seed=7, horizon=20.0, crashes=2, drop_windows=1, device_stalls=1,
+        replica_ids=["r0", "r1", "r2", "r3"],
+    )
+    assert len(s.events) == 4
+    kinds = sorted(e.kind for e in s.events)
+    assert kinds == ["crash", "crash", "drop_window", "stall_device"]
+    for e in s.events:
+        assert 0.1 * 20.0 <= e.t <= 0.9 * 20.0  # clean setup/drain edges
+    assert list(s.events) == sorted(s.events, key=lambda e: (e.t, e.kind, e.target))
+    # summary round-trips the regeneration arguments
+    summ = s.summary()
+    assert summ["seed"] == 7
+    assert summ["counts"] == {"crash": 2, "drop_window": 1, "stall_device": 1}
+
+
+def test_parse_cli_spec_and_reject_typos():
+    s = FaultSchedule.parse("seed=9,crashes=2,stalls=1", horizon=10.0)
+    assert s.seed == 9
+    assert sum(1 for e in s.events if e.kind == "crash") == 2
+    assert sum(1 for e in s.events if e.kind == "stall_device") == 1
+    # same spec -> same schedule (the CLI path keeps the replay contract)
+    assert s == FaultSchedule.parse("seed=9,crashes=2,stalls=1", horizon=10.0)
+    with pytest.raises(ValueError, match="crashs"):
+        FaultSchedule.parse("crashs=2", horizon=10.0)
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+
+
+class _Inner:
+    name = "inner"
+    device_calls = 0
+    device_items = 0
+    device_seconds = 0.0
+
+    def __init__(self):
+        self.seen = []
+
+    def verify_batch(self, items):
+        self.seen.append(len(items))
+        return [True] * len(items)
+
+    def dispatch_batch(self, items):
+        items = list(items)
+        return lambda: self.verify_batch(items)
+
+
+def test_slow_verifier_arms_and_disarms():
+    inner = _Inner()
+    sv = SlowVerifier(inner)
+    t0 = time.perf_counter()
+    assert sv.verify_batch([1, 2]) == [True, True]
+    assert time.perf_counter() - t0 < 0.05  # disarmed: no delay
+    sv.arm(0.1)
+    t0 = time.perf_counter()
+    assert sv.verify_batch([1]) == [True]
+    assert time.perf_counter() - t0 >= 0.1
+    sv.disarm()
+    t0 = time.perf_counter()
+    sv.verify_batch([1])
+    assert time.perf_counter() - t0 < 0.05
+    assert sv.name == "inner"  # passthrough
+
+
+def test_stallable_device_blocks_then_releases():
+    inner = _Inner()
+    dev = StallableDevice(inner)
+    # healthy: instant
+    assert dev.verify_batch([1, 2, 3]) == [True] * 3
+    dev.stall(duration=0.2)
+    assert dev.stalled
+    t0 = time.perf_counter()
+    out = dev.dispatch_batch([1])()  # blocks until the auto-release
+    assert time.perf_counter() - t0 >= 0.15
+    assert out == [True]
+    assert dev.stalls_injected == 1 and dev.finishers_stalled == 1
+    # manual release path
+    dev.stall()
+    assert dev.stalled
+    dev.release()
+    assert not dev.stalled
+    assert dev.verify_batch([1]) == [True]
+
+
+def test_stallable_device_counter_passthrough_survives_writes():
+    """VerifyService (and the bench) write device_calls/items/seconds
+    through the wrapper; the write must reach the INNER counters, not
+    shadow them on the wrapper."""
+    inner = _Inner()
+    dev = StallableDevice(inner)
+    dev.device_calls = 7
+    inner.device_calls += 1
+    assert dev.device_calls == 8  # reads keep tracking the inner value
+    dev.device_seconds = 1.5
+    assert inner.device_seconds == 1.5
+
+
+# ---------------------------------------------------------------------------
+# injector semantics
+# ---------------------------------------------------------------------------
+
+
+def test_injector_crash_respects_quorum_floor():
+    """n=4 (quorum 3): a 3-crash schedule may only apply ONE crash —
+    never below 2f+1 live replicas (a resilience run must stay a
+    liveness-possible configuration)."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1, verify_signatures=False)
+        com.start()
+        schedule = FaultSchedule(
+            seed=0, horizon=1.0,
+            events=tuple(
+                FaultEvent(t=0.01 * (i + 1), kind="crash") for i in range(3)
+            ),
+        )
+        injector = FaultInjector(committee=com, schedule=schedule)
+        try:
+            await injector.run(time.perf_counter() + 2.0)
+            assert injector.crashes_applied == 1
+            assert injector.skipped == 2
+            live = sum(1 for r in com.replicas if r._running)
+            assert live == 3 == com.cfg.quorum
+        finally:
+            await com.stop()
+
+    run(scenario())
+
+
+def test_injector_windows_apply_and_restore():
+    """drop/delay windows raise the network knobs for their duration and
+    restore the previous values afterwards — and stop() restores early
+    (no degraded settings may leak into the drain phase)."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1, verify_signatures=False)
+        schedule = FaultSchedule(
+            seed=0, horizon=2.0,
+            events=(
+                FaultEvent(t=0.0, kind="drop_window", duration=0.2,
+                           magnitude=0.5),
+                FaultEvent(t=0.0, kind="delay_window", duration=30.0,
+                           magnitude=0.04),
+            ),
+        )
+        injector = FaultInjector(committee=com, schedule=schedule)
+        task = asyncio.create_task(injector.run(time.perf_counter() + 1.0))
+        await asyncio.sleep(0.1)
+        assert com.net.faults.drop_rate == pytest.approx(0.5)
+        assert com.net.faults.delay_range == (0.0, 0.04)
+        await asyncio.sleep(0.25)  # the 0.2 s drop window expires
+        assert com.net.faults.drop_rate == 0.0
+        assert com.net.faults.delay_range == (0.0, 0.04)  # still open
+        injector.stop()  # cancels the 30 s window -> restores NOW
+        await asyncio.gather(task, return_exceptions=True)
+        assert com.net.faults.delay_range == (0.0, 0.0)
+
+    run(scenario())
+
+
+def test_injector_skips_seamless_faults():
+    """stall_device without a service / slow_verifier without a wrapper
+    are counted skipped, never raised — a CPU-only run just has no
+    device to stall."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1, verify_signatures=False)
+        schedule = FaultSchedule(
+            seed=0, horizon=1.0,
+            events=(
+                FaultEvent(t=0.0, kind="stall_device", duration=1.0),
+                FaultEvent(t=0.0, kind="slow_verifier", duration=1.0,
+                           magnitude=0.1),
+            ),
+        )
+        injector = FaultInjector(committee=com, schedule=schedule)
+        await injector.run(time.perf_counter() + 1.0)
+        assert injector.skipped == 2
+        assert all(not rec["applied"] for rec in injector.applied)
+
+    run(scenario())
+
+
+def test_injector_slow_verifier_window():
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1, verify_signatures=False)
+        slow = SlowVerifier(_Inner())
+        schedule = FaultSchedule(
+            seed=0, horizon=1.0,
+            events=(
+                FaultEvent(t=0.0, kind="slow_verifier", duration=0.15,
+                           magnitude=0.07),
+            ),
+        )
+        injector = FaultInjector(committee=com, schedule=schedule, slow=slow)
+        task = asyncio.create_task(injector.run(time.perf_counter() + 1.0))
+        await asyncio.sleep(0.05)
+        assert slow._delay == pytest.approx(0.07)
+        await asyncio.gather(task, return_exceptions=True)
+        assert slow._delay == 0.0  # window expired -> disarmed
+
+    run(scenario())

@@ -1,0 +1,501 @@
+"""Overload resilience (ISSUE 1 tentpole): bounded admission, the
+device-stall watchdog with CPU failover + quarantine, per-pile latency
+isolation, replica priority shedding, and client backoff/recovery.
+
+The r5 evidence these pin: qc256 committed ZERO requests with
+svc_rtt_ms_ema ~15,000 ms (unbounded pile growth) and one 25-minute
+wedge (a silent device call nothing ever timed out). Every test here is
+the counterfactual: the pile stays bounded, the wedge becomes a CPU
+failover, and shed work RECOVERS through client retries instead of
+becoming an unexplained timeout.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from simple_pbft_tpu.committee import LocalCommittee
+from simple_pbft_tpu.crypto.coalesce import Overloaded, VerifyService
+from simple_pbft_tpu.crypto.verifier import BatchItem, best_cpu_verifier
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+class FakeDevice:
+    """Device double (sig == msg predicate) with a completion gate."""
+
+    def __init__(self, gate: bool = False):
+        self.batches = []
+        self.device_calls = 0
+        self.device_items = 0
+        self.device_seconds = 0.0
+        self._gate = threading.Event()
+        if not gate:
+            self._gate.set()
+
+    def release(self):
+        self._gate.set()
+
+    def dispatch_batch(self, items):
+        items = list(items)
+        self.batches.append(len(items))
+        self.device_calls += 1
+        self.device_items += len(items)
+
+        def finish():
+            self._gate.wait(60)
+            return [it.sig == it.msg for it in items]
+
+        return finish
+
+
+class FakeCpu:
+    def __init__(self, delay_per_item: float = 0.0):
+        self.batches = []
+        self.delay_per_item = delay_per_item
+
+    def verify_batch(self, items):
+        self.batches.append(len(items))
+        if self.delay_per_item:
+            time.sleep(self.delay_per_item * len(items))
+        return [it.sig == it.msg for it in items]
+
+
+def _items(n, tag=b"x", good=True):
+    return [
+        BatchItem(
+            b"pk",
+            tag + bytes([i % 251]),
+            tag + bytes([i % 251]) if good else b"bad",
+        )
+        for i in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# bounded admission
+# ---------------------------------------------------------------------------
+
+
+def test_submit_past_cap_rejected_with_overloaded():
+    """Submit rate > drain rate must bound queue depth and reject with
+    Overloaded — never grow the pile (acceptance criterion 4)."""
+    dev = FakeDevice(gate=True)
+    svc = VerifyService(dev, cpu=FakeCpu(), cpu_cutoff=0, max_pending=500)
+    # two piles > MIN_SECOND_DISPATCH occupy both device slots (submitted
+    # sequentially — back-to-back submits would coalesce into ONE pass)
+    inflight = [svc.submit(_items(300, tag=b"a"))]
+    for _ in range(200):
+        if len(dev.batches) == 1:
+            break
+        time.sleep(0.005)
+    inflight.append(svc.submit(_items(300, tag=b"b")))
+    for _ in range(200):
+        if len(dev.batches) == 2:
+            break
+        time.sleep(0.005)
+    assert len(dev.batches) == 2
+    queued = svc.submit(_items(400, tag=b"c"))  # fits the 500 cap
+    rejected = svc.submit(_items(200, tag=b"d"))  # 600 > 500: rejected
+    with pytest.raises(Overloaded):
+        rejected.result(5)
+    assert svc.overload_rejections == 1
+    assert svc.overload_rejected_items == 200
+    assert svc.max_pending_seen <= 500
+    # drain: everything admitted still resolves, and NEW work is accepted
+    dev.release()
+    for f in inflight:
+        assert f.result(10) == [True] * 300
+    assert queued.result(10) == [True] * 400
+    assert svc.submit(_items(50, tag=b"e")).result(10) == [True] * 50
+    svc.close()
+
+
+def test_single_oversized_submission_still_admitted_when_idle():
+    """One batch larger than max_pending with an EMPTY queue must be
+    admitted (and chunked downstream), or it could never run at all."""
+    svc = VerifyService(
+        FakeDevice(), cpu=FakeCpu(), cpu_cutoff=0, max_pending=100
+    )
+    assert svc.submit(_items(250)).result(10) == [True] * 250
+    assert svc.overload_rejections == 0
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# dispatch-deadline watchdog + quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_fails_stalled_sweep_over_to_cpu():
+    """A device call past the deadline resolves via the CPU verifier
+    within ~the deadline — the committee's quorum sweep is never held
+    hostage by a silent device (acceptance criterion 3)."""
+    dev = FakeDevice(gate=True)  # never released: permanent stall
+    cpu = FakeCpu()
+    svc = VerifyService(
+        dev, cpu=cpu, cpu_cutoff=0, dispatch_deadline=0.2,
+        quarantine_base=0.5,
+    )
+    t0 = time.perf_counter()
+    out = svc.submit(_items(300)).result(10)
+    took = time.perf_counter() - t0
+    assert out == [True] * 300
+    assert took < 5.0  # deadline + CPU pass, not the 60 s gate wait
+    assert svc.watchdog_failovers == 1
+    assert svc.cpu_reroute_passes >= 1
+    assert svc.quarantined and svc.degraded
+    # quarantined: big piles route to the CPU, the device is left alone
+    assert svc.submit(_items(300, tag=b"q")).result(10) == [True] * 300
+    assert len(dev.batches) == 1
+    svc.close()
+
+
+def test_late_device_completion_lifts_quarantine():
+    """The abandoned finisher eventually landing is evidence of device
+    health: quarantine lifts early instead of waiting out the backoff."""
+    dev = FakeDevice(gate=True)
+    svc = VerifyService(
+        dev, cpu=FakeCpu(), cpu_cutoff=0, dispatch_deadline=0.2,
+        quarantine_base=30.0,  # would bench the device for 30 s
+    )
+    assert svc.submit(_items(300)).result(10) == [True] * 300
+    assert svc.quarantined
+    dev.release()  # the stalled call lands late
+    for _ in range(200):
+        if svc.late_device_completions and not svc.quarantined:
+            break
+        time.sleep(0.01)
+    assert svc.late_device_completions == 1
+    assert not svc.quarantined
+    svc.close()
+
+
+def test_reprobe_backoff_doubles_on_repeat_failure():
+    """Re-probing a still-dead device must back off exponentially, not
+    hammer it at the base interval."""
+    dev = FakeDevice(gate=True)
+    svc = VerifyService(
+        dev, cpu=FakeCpu(), cpu_cutoff=0, dispatch_deadline=0.1,
+        quarantine_base=0.2, quarantine_cap=5.0,
+    )
+    assert svc.submit(_items(300)).result(10) == [True] * 300
+    assert svc._quarantine_backoff == pytest.approx(0.4)
+    time.sleep(0.3)  # first quarantine window expires
+    assert not svc.quarantined
+    # next big pile is the re-probe; the device is still dead
+    assert svc.submit(_items(300, tag=b"p")).result(10) == [True] * 300
+    assert svc.watchdog_failovers == 2
+    assert svc.quarantine_probes >= 1
+    assert svc._quarantine_backoff == pytest.approx(0.8)
+    svc.close()
+
+
+def test_small_sweeps_not_serialized_behind_big_cpu_reroute():
+    """Per-pile latency isolation: a multi-thousand-item CPU reroute runs
+    on its own thread, so a 10-item quorum sweep submitted right behind
+    it clears in milliseconds, not after the big pile."""
+    dev = FakeDevice(gate=True)
+    cpu = FakeCpu(delay_per_item=0.001)  # 2000 items => ~2 s
+    svc = VerifyService(
+        dev, cpu=cpu, cpu_cutoff=64, dispatch_deadline=0.1,
+        quarantine_base=10.0,
+    )
+    # trip the watchdog to quarantine the device
+    assert svc.submit(_items(100)).result(10) == [True] * 100
+    assert svc.quarantined
+    big = svc.submit(_items(2000, tag=b"B"))
+    time.sleep(0.05)  # let the dispatcher take the big pile first
+    t0 = time.perf_counter()
+    small = svc.submit(_items(10, tag=b"s"))
+    assert small.result(10) == [True] * 10
+    small_latency = time.perf_counter() - t0
+    assert not big.done()  # the big reroute is still grinding
+    assert small_latency < 1.0
+    assert big.result(15) == [True] * 2000
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# replica priority shedding
+# ---------------------------------------------------------------------------
+
+
+def test_priority_shedding_keeps_quorum_traffic_first():
+    """Past the shed watermark: every quorum-critical message survives,
+    deferrable ones fill the remaining budget in arrival order, the rest
+    drop, and degraded_mode flags (then clears on a calm sweep)."""
+
+    async def scenario():
+        from simple_pbft_tpu.crypto.signer import Signer
+        from simple_pbft_tpu.messages import Prepare, Request
+
+        com = LocalCommittee.build(n=4, clients=1, verify_signatures=False)
+        r0 = com.replica("r0")
+        r0.shed_watermark = 4
+        reqs = []
+        signer = Signer("c0", com.keys["c0"].seed)
+        for i in range(5):
+            rq = Request(client_id="c0", timestamp=1000 + i, operation="noop")
+            signer.sign_msg(rq)
+            reqs.append(rq)
+        preps = []
+        s1 = Signer("r1", com.keys["r1"].seed)
+        for i in range(3):
+            pp = Prepare(view=0, seq=i + 1, digest="a" * 64)
+            s1.sign_msg(pp)
+            preps.append(pp)
+        # arrival order: req, req, prep, req, prep, req, prep, req
+        order = [reqs[0], reqs[1], preps[0], reqs[2], preps[1], reqs[3],
+                 preps[2], reqs[4]]
+        decoded, _spans, _task = r0._start_sweep([m.to_wire() for m in order])
+        # all 3 prepares kept + budget (4-3=1) -> first request only
+        kinds = [type(m).__name__ for m in decoded]
+        assert kinds == ["Request", "Prepare", "Prepare", "Prepare"]
+        assert decoded[0].timestamp == 1000  # arrival order preserved
+        assert r0.metrics["messages_shed"] == 4
+        assert r0.metrics["degraded_mode"] == 1
+        # a calm sweep (<= watermark/2) clears the degraded flag
+        r0._start_sweep([reqs[0].to_wire()])
+        assert r0.metrics["degraded_mode"] == 0
+
+    run(scenario())
+
+
+def test_no_shedding_below_watermark():
+    async def scenario():
+        from simple_pbft_tpu.crypto.signer import Signer
+        from simple_pbft_tpu.messages import Request
+
+        com = LocalCommittee.build(n=4, clients=1, verify_signatures=False)
+        r0 = com.replica("r0")
+        signer = Signer("c0", com.keys["c0"].seed)
+        wires = []
+        for i in range(10):
+            rq = Request(client_id="c0", timestamp=2000 + i, operation="noop")
+            signer.sign_msg(rq)
+            wires.append(rq.to_wire())
+        decoded, _s, _t = r0._start_sweep(wires)
+        assert len(decoded) == 10
+        assert r0.metrics["messages_shed"] == 0
+        assert r0.metrics.get("degraded_mode", 0) == 0
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# client backoff + idempotent retry
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_schedule_grows_capped_and_deterministic():
+    from simple_pbft_tpu.client import Client
+    from simple_pbft_tpu.config import make_test_committee
+    from simple_pbft_tpu.transport.local import LocalNetwork
+
+    cfg, keys = make_test_committee(n=4, clients=1)
+    net = LocalNetwork()
+
+    def mk():
+        return Client(
+            client_id="c0", cfg=cfg, seed=keys["c0"].seed,
+            transport=net.endpoint("c0"), request_timeout=1.0,
+            backoff_factor=2.0, jitter=0.1,
+        )
+
+    c1, c2 = mk(), mk()
+    sched1 = [c1._attempt_timeout(k) for k in range(8)]
+    sched2 = [c2._attempt_timeout(k) for k in range(8)]
+    assert sched1 == sched2  # same seed -> same jitter stream
+    # grows ~2x within jitter until the 8x cap
+    assert sched1[0] == pytest.approx(1.0, rel=0.11)
+    assert sched1[2] == pytest.approx(4.0, rel=0.11)
+    assert all(t <= 8.0 * 1.1 + 1e-9 for t in sched1)
+    assert sched1[6] == pytest.approx(8.0, rel=0.11)  # capped
+    # factor 1.0 restores the fixed-interval legacy behavior (no growth)
+    c3 = mk()
+    c3.backoff_factor, c3.jitter = 1.0, 0.0
+    assert [c3._attempt_timeout(k) for k in range(4)] == [1.0] * 4
+
+
+def test_client_retry_recovers_after_partition_exactly_once():
+    """A request lost to a partition recovers via backoff retransmission
+    and executes EXACTLY once (idempotent dedup server-side)."""
+
+    async def scenario():
+        com = LocalCommittee.build(n=4, clients=1)
+        for rid in com.cfg.replica_ids:
+            com.net.faults.cut("c0", rid)
+        com.start()
+        client = com.clients[0]
+        client.request_timeout = 0.3
+
+        async def heal():
+            await asyncio.sleep(0.8)
+            com.net.faults.heal()
+
+        heal_task = asyncio.create_task(heal())
+        try:
+            assert await client.submit("put k recovered", retries=10) == "ok"
+            assert client.metrics["retransmissions"] >= 1
+            assert client.metrics["recovered_after_retry"] == 1
+            for r in com.replicas:
+                assert r.metrics["committed_requests"] == 1
+        finally:
+            await heal_task
+            await com.stop()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# end to end: overload shed -> client retry recovery;
+#             seeded stalled device -> watchdog -> commits continue
+# ---------------------------------------------------------------------------
+
+
+class GatedCpuDevice:
+    """Real-verdict device double: verifies with the CPU backend inside
+    its finisher (so committee signatures get genuine outcomes), with a
+    gate to hold passes in flight."""
+
+    def __init__(self, gate: bool = False):
+        self._cpu = best_cpu_verifier()
+        self.batches = []
+        self.device_calls = 0
+        self.device_items = 0
+        self.device_seconds = 0.0
+        self._gate = threading.Event()
+        if not gate:
+            self._gate.set()
+
+    def release(self):
+        self._gate.set()
+
+    def dispatch_batch(self, items):
+        items = list(items)
+        self.batches.append(len(items))
+        self.device_calls += 1
+        self.device_items += len(items)
+
+        def finish():
+            self._gate.wait(60)
+            return self._cpu.verify_batch(items)
+
+        return finish
+
+
+def test_overloaded_sweeps_shed_and_client_retries_recover():
+    """Acceptance criterion 4, end to end: with the verify pile pinned at
+    its admission cap, replica sweeps are rejected (shed, counted) — and
+    once the pile drains, the client's retries recover the request
+    instead of it becoming a timeout."""
+
+    async def scenario():
+        dev = GatedCpuDevice(gate=True)
+        svc = VerifyService(
+            dev, cpu=best_cpu_verifier(), cpu_cutoff=0, max_pending=40
+        )
+        # occupy both device slots, then pin the queue at the cap
+        svc.submit(_items(300, tag=b"a"))
+        svc.submit(_items(300, tag=b"b"))
+        for _ in range(200):
+            if len(dev.batches) == 2:
+                break
+            await asyncio.sleep(0.005)
+        filler = svc.submit(_items(40, tag=b"c"))
+        com = LocalCommittee.build(n=4, clients=1, verifier_factory=lambda: svc)
+        com.start()
+        client = com.clients[0]
+        client.request_timeout = 0.3
+        task = asyncio.create_task(client.submit("put k v", retries=30))
+        try:
+            # every sweep is admission-rejected while the pile is pinned
+            for _ in range(300):
+                if sum(
+                    r.metrics.get("sweeps_shed_overload", 0)
+                    for r in com.replicas
+                ) >= 1:
+                    break
+                await asyncio.sleep(0.01)
+            shed = sum(
+                r.metrics.get("sweeps_shed_overload", 0) for r in com.replicas
+            )
+            assert shed >= 1
+            assert any(
+                r.metrics.get("degraded_mode", 0) for r in com.replicas
+            )
+            assert svc.overload_rejections >= 1
+            dev.release()  # drain: the committee recovers
+            assert await asyncio.wait_for(task, 30) == "ok"
+            # the pinned filler drained too (fake items: all invalid —
+            # what matters is the future RESOLVED, not wedged)
+            assert filler.result(10) == [False] * 40
+        finally:
+            if not task.done():
+                task.cancel()
+            await com.stop()
+            svc.close()
+
+    run(scenario(), timeout=120)
+
+
+def test_seeded_stalled_device_schedule_does_not_wedge():
+    """Acceptance criterion 3: under a SEEDED stall_device schedule the
+    watchdog fails verification over to the CPU within the deadline and
+    the committee keeps committing — nonzero commits despite the device
+    being silent for most of the window."""
+
+    async def scenario():
+        from simple_pbft_tpu.faults import (
+            FaultInjector,
+            FaultSchedule,
+            StallableDevice,
+        )
+
+        dev = StallableDevice(GatedCpuDevice())
+        svc = VerifyService(
+            dev, cpu=best_cpu_verifier(), cpu_cutoff=0,
+            dispatch_deadline=0.3, quarantine_base=0.5,
+        )
+        schedule = FaultSchedule.generate(
+            seed=99, horizon=3.0, device_stalls=1, stall_s=10.0
+        )
+        assert schedule.events[0].kind == "stall_device"
+        com = LocalCommittee.build(
+            n=4, clients=1, verifier_factory=lambda: svc
+        )
+        com.start()
+        client = com.clients[0]
+        client.request_timeout = 1.0
+        injector = FaultInjector(
+            committee=com, schedule=schedule, service=svc
+        )
+        inj_task = asyncio.create_task(injector.run(time.perf_counter() + 8.0))
+        commits = 0
+        try:
+            t_end = time.perf_counter() + 5.0
+            i = 0
+            while time.perf_counter() < t_end:
+                assert await client.submit(f"put k{i} {i}", retries=20) == "ok"
+                commits += 1
+                i += 1
+            assert commits > 0  # the committee kept committing
+            # the stall actually happened and the watchdog caught it
+            # (stall lasts 10 s, the load window is 5 s: commits after
+            # the event fired can only have gone through the failover)
+            assert dev.stalls_injected == 1
+            assert svc.watchdog_failovers >= 1
+            assert svc.cpu_reroute_passes >= 1
+        finally:
+            injector.stop()
+            dev.release()
+            await asyncio.gather(inj_task, return_exceptions=True)
+            await com.stop()
+            svc.close()
+
+    run(scenario(), timeout=120)

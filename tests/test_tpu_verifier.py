@@ -214,6 +214,59 @@ def test_keybank_cap_falls_back_to_cpu():
     assert len(v._bank._index) == 2
 
 
+def test_overbank_fallback_agrees_with_kernel():
+    """The over-bank-cap fallback must be KERNEL-EQUIVALENT (ADVICE r5):
+    the same batch split between kernel rows and fallback rows shares
+    one verdict bitmap, so the two paths must agree on every known edge
+    vector — non-canonical S (>= L), y >= p key encodings, wrong
+    lengths, tampered bits — or a crafted signature could verify on one
+    replica's split and not another's. Pins both the agreement and the
+    fallback CLASS (native/oracle, never OpenSSL)."""
+    from simple_pbft_tpu.crypto.tpu_verifier import KeyBank
+    from simple_pbft_tpu.crypto.verifier import (
+        CpuVerifier,
+        NativeEdVerifier,
+        kernel_equivalent_cpu_verifier,
+    )
+
+    good = [_signed(50 + i, b"edge %d" % i) for i in range(3)]
+    flipped = bytearray(good[0].sig)
+    flipped[1] ^= 0x40
+    noncanon_s = good[1].sig[:32] + (
+        (int.from_bytes(good[1].sig[32:], "little") + ref.L).to_bytes(
+            32, "little"
+        )
+    )
+    edge_items = [
+        good[0],
+        BatchItem(good[0].pubkey, good[0].msg, bytes(flipped)),
+        good[1],
+        BatchItem(good[1].pubkey, b"forged", good[1].sig),
+        BatchItem(good[1].pubkey, good[1].msg, noncanon_s),  # S >= L
+        BatchItem(good[2].pubkey[:16], good[2].msg, good[2].sig),  # bad len
+        BatchItem(b"\xff" * 32, good[2].msg, good[2].sig),  # y >= p
+        good[2],
+    ]
+    oracle = [ref.verify(i.pubkey, i.msg, i.sig) for i in edge_items]
+    # kernel verdicts: roomy bank, every key resident
+    kernel = TpuVerifier().verify_batch(edge_items)
+    assert kernel == oracle
+    # fallback verdicts: bank capacity 1, pre-occupied by an unrelated
+    # key, so EVERY edge item routes to the over-cap fallback path
+    v = TpuVerifier()
+    v._bank = KeyBank(initial_capacity=1, max_keys=1, mode=v._mode)
+    occupier = _signed(99, b"occupier")
+    assert v.verify_batch([occupier]) == [True]
+    assert len(v._bank._index) == 1
+    got = v.verify_batch(edge_items)
+    assert got == kernel == oracle
+    assert len(v._bank._index) == 1  # nothing evicted/registered
+    # the fallback actually ran and is a kernel-equivalent class
+    assert v._cpu_fb is not None
+    assert isinstance(v._cpu_fb, (NativeEdVerifier, CpuVerifier))
+    assert type(kernel_equivalent_cpu_verifier()) is type(v._cpu_fb)
+
+
 @pytest.mark.parametrize("packed", [False, True], ids=["dense", "packed"])
 def test_meshed_tpu_verifier_fused(packed):
     """TpuVerifier(mesh=...) fused mode: the GSPMD-sharded jit path (with

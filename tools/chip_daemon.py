@@ -393,14 +393,40 @@ QUEUE_OVERRIDE = os.path.join(
 )
 
 
+# one log line per DISTINCT broken override file, not one per queue
+# poll: the poll runs every few seconds, so a forgotten malformed spec
+# used to bury the daemon log in identical lines (ADVICE r5). Keyed by
+# the file's (mtime, size) version stamp — an edit (even back to the
+# same bad content) logs again, an unchanged file never re-logs.
+_override_complained: "set[tuple]" = set()
+
+
+def _override_stamp() -> tuple:
+    try:
+        st = os.stat(QUEUE_OVERRIDE)
+        return (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return (0, 0)
+
+
+def _log_override_once(key: str, msg: str) -> None:
+    stamp = (_override_stamp(), key)
+    if stamp in _override_complained:
+        return
+    if len(_override_complained) > 256:  # stale stamps from old edits
+        _override_complained.clear()
+    _override_complained.add(stamp)
+    _log(msg)
+
+
 def _override_experiments() -> list[dict]:
     """Operator-editable experiment specs, consulted BEFORE the static
     queue so new experiments (a post-fix re-run, an A/B) can be added
     without restarting a daemon that is mid-experiment. File format:
     a JSON list of {"exp", "kind": "consensus"|"bench"|"replica_unit",
     "args": [...] (consensus/replica_unit) or "env": {...} (bench),
-    "timeout": seconds}. A malformed file is ignored loudly rather than
-    crashing the queue loop."""
+    "timeout": seconds}. A malformed file is ignored loudly (once per
+    file version) rather than crashing the queue loop."""
     try:
         with open(QUEUE_OVERRIDE) as f:
             specs = json.load(f)
@@ -408,7 +434,9 @@ def _override_experiments() -> list[dict]:
     except FileNotFoundError:
         return []
     except Exception as e:  # noqa: BLE001
-        _log(f"queue override unreadable ({e!r}); ignoring")
+        _log_override_once(
+            "unreadable", f"queue override unreadable ({e!r}); ignoring"
+        )
         return []
     out = []
     for spec in specs:
@@ -435,7 +463,10 @@ def _override_experiments() -> list[dict]:
                     _consensus_exp(name, [str(a) for a in args], timeout, **env)
                 )
         except Exception as e:  # noqa: BLE001
-            _log(f"queue override spec {spec!r} malformed ({e!r}); skipping")
+            _log_override_once(
+                f"spec:{spec!r}",
+                f"queue override spec {spec!r} malformed ({e!r}); skipping",
+            )
     return out
 
 
