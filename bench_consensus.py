@@ -75,6 +75,38 @@ def _start_watchdog(budget: float) -> None:
 from simple_pbft_tpu.client import SupersededError
 
 
+def _committee_telemetry(com, service=None) -> dict:
+    """Committee-wide aggregate of the unified telemetry plane
+    (simple_pbft_tpu/telemetry.py): replica counters summed, transport
+    counters summed, execution frontier spread, verify-service snapshot.
+    Scraped at the start and end of the measurement window so every
+    BENCH_*.json cell carries the telemetry that explains it."""
+    from collections import defaultdict
+
+    from simple_pbft_tpu.telemetry import SCHEMA_VERSION
+
+    agg, tx = defaultdict(int), defaultdict(int)
+    for r in com.replicas:
+        for k, v in r.metrics.items():
+            agg[k] += v
+        for k, v in getattr(r.transport, "metrics", {}).items():
+            tx[k] += v
+    exec_seqs = sorted(r.executed_seq for r in com.replicas)
+    out = {
+        "schema": SCHEMA_VERSION,
+        "t_wall": round(time.time(), 3),
+        "replicas_running": sum(1 for r in com.replicas if r._running),
+        "exec_seq_min": exec_seqs[0] if exec_seqs else 0,
+        "exec_seq_max": exec_seqs[-1] if exec_seqs else 0,
+        "views": sorted({r.view for r in com.replicas}),
+        "replica_metrics": dict(sorted(agg.items())),
+        "transport": dict(sorted(tx.items())),
+    }
+    if service is not None:
+        out["verify"] = service.snapshot()
+    return out
+
+
 async def _pump(client, stop_at: float, latencies: List[float], errors: List[int]):
     """One closed-loop driver: keep exactly one request in flight, record
     per-request latency. Concurrency comes from running many of these.
@@ -128,6 +160,9 @@ async def run_config(
     fault_spec: str = None,
     verify_deadline: float = 60.0,
     verify_max_pending: int = 65536,
+    status_port_base: int = 0,
+    flight_dir: str = None,
+    trace_sample: int = 0,
 ) -> dict:
     from simple_pbft_tpu.committee import LocalCommittee
     from simple_pbft_tpu.crypto.coalesce import VerifyService
@@ -301,6 +336,44 @@ async def run_config(
 
     com.start()
 
+    # live telemetry plane (ISSUE 2): per-replica /metrics.json endpoints
+    # mid-run, crash-surviving flight-recorder timelines, and sampled
+    # phase-level traces that join client and replica events
+    status_servers = []
+    recorders = []
+    tracers = {}
+    if trace_sample > 0:
+        tracers = com.attach_tracers(
+            sample_mod=trace_sample, trace_dir=flight_dir
+        )
+    if status_port_base > 0 or flight_dir:
+        from simple_pbft_tpu.telemetry import FlightRecorder, StatusServer
+
+        for i, r in enumerate(com.replicas):
+            tel = com.node_telemetry(r.id)
+            if status_port_base > 0:
+                srv = StatusServer(tel, port=status_port_base + i)
+                await srv.start()
+                status_servers.append(srv)
+            if flight_dir:
+                rec_f = FlightRecorder(
+                    tel,
+                    os.path.join(flight_dir, f"{r.id}.flight.jsonl"),
+                    interval=0.5,
+                )
+                rec_f.start()
+                recorders.append(rec_f)
+        if status_servers:
+            print(
+                f"telemetry: /metrics.json on 127.0.0.1:"
+                f"{status_port_base}..{status_port_base + n - 1}",
+                file=sys.stderr,
+            )
+
+    telemetry_start = _committee_telemetry(
+        com, service if verifier == "tpu" else None
+    )
+
     latencies: List[float] = []
     errors: List[int] = []
     t_start = time.perf_counter()
@@ -450,7 +523,17 @@ async def run_config(
             svc_late_device_completions=service.late_device_completions,
         )
 
+    telemetry_end = _committee_telemetry(
+        com, service if verifier == "tpu" else None
+    )
+    for rec_f in recorders:
+        await rec_f.stop()
+    for srv in status_servers:
+        await srv.stop()
+
     await com.stop()
+    for tr in tracers.values():
+        tr.close()
     if verifier == "tpu":
         service.close()
 
@@ -494,6 +577,12 @@ async def run_config(
     rec.update(shed_info)
     rec.update(verify_stats)
     rec.update(crash_info)
+    # start/end unified snapshots: the cell carries the telemetry that
+    # explains it (e.g. a low committed_req_s with end.verify.quarantined
+    # true and messages_shed high IS the diagnosis, no log forensics)
+    rec["telemetry"] = {"start": telemetry_start, "end": telemetry_end}
+    if trace_sample > 0:
+        rec["trace_events"] = sum(t.events_emitted for t in tracers.values())
     if schedule is not None:
         rec["faults"] = schedule.summary()
         rec["faults_applied"] = injector.applied_count
@@ -544,6 +633,23 @@ async def main() -> None:
         "--verify-max-pending", type=int, default=65536,
         help="tpu verify service: pending-item cap; submits past it are "
         "admission-rejected with Overloaded instead of queued",
+    )
+    ap.add_argument(
+        "--status-port-base", type=int, default=0,
+        help="live telemetry: serve each replica's /metrics.json at "
+        "127.0.0.1:(base+i) during the run (0 disables) — scrape with "
+        "tools/pbft_top.py --endpoints or curl",
+    )
+    ap.add_argument(
+        "--flight-dir", default=None,
+        help="write per-replica flight-recorder JSONL (and trace JSONL "
+        "when --trace-sample is set) under this directory; a SIGKILLed "
+        "run still leaves its snapshot timeline",
+    )
+    ap.add_argument(
+        "--trace-sample", type=int, default=0,
+        help="phase-level request tracing: keep ~1/N of requests "
+        "(deterministic hash sampling; 1 traces everything, 0 off)",
     )
     ap.add_argument(
         "--view-timeout", type=float, default=0.0,
@@ -608,6 +714,9 @@ async def main() -> None:
             fault_spec=args.fault_schedule,
             verify_deadline=args.verify_deadline,
             verify_max_pending=args.verify_max_pending,
+            status_port_base=args.status_port_base,
+            flight_dir=args.flight_dir,
+            trace_sample=args.trace_sample,
         )
         if args.storm:
             rec = await run_config(

@@ -107,6 +107,10 @@ class Client:
         self._bg_tasks: set = set()
         self._task: Optional[asyncio.Task] = None
         self.view_hint = 0  # latest view seen in replies
+        # sampled request tracing (telemetry.RequestTracer), attached
+        # after construction; the client stamps submit/retransmit/
+        # accepted so a trace joins the replica-side phases end to end
+        self.tracer = None
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._recv_loop())
@@ -282,6 +286,11 @@ class Client:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._waiters[ts] = fut
         self._inflight_raw[ts] = raw
+        tracer = self.tracer
+        rid = tracer.rid_if_sampled(self.id, ts) if tracer is not None else None
+        traced = rid is not None
+        if traced:
+            tracer.emit("submit", rid, op_bytes=len(operation))
         try:
             # first attempt: primary (+ hedged backups); afterwards:
             # broadcast (classic PBFT retransmission — backups forward to
@@ -304,12 +313,18 @@ class Client:
                     )
                     if attempt:
                         self.metrics["recovered_after_retry"] += 1
+                    if traced:
+                        tracer.emit("accepted", rid, attempts=attempt + 1)
                     return result
                 except asyncio.TimeoutError:
                     if attempt == retries:
                         self.metrics["request_timeouts"] += 1
+                        if traced:
+                            tracer.emit("timeout", rid, attempts=attempt + 1)
                         raise
                     self.metrics["retransmissions"] += 1
+                    if traced:
+                        tracer.emit("retransmit", rid, attempts=attempt + 1)
                     await self.transport.broadcast(raw, self.cfg.replica_ids)
             raise asyncio.TimeoutError  # pragma: no cover
         finally:

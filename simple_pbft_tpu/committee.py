@@ -86,3 +86,46 @@ class LocalCommittee:
 
     def replica(self, rid: str) -> Replica:
         return next(r for r in self.replicas if r.id == rid)
+
+    # -- telemetry plane (simple_pbft_tpu/telemetry.py) -----------------
+
+    def node_telemetry(self, node_id: str):
+        """Unified-telemetry registry for one node of this committee
+        (replica or client) — the object StatusServer / FlightRecorder
+        serve from."""
+        from .telemetry import NodeTelemetry
+
+        for r in self.replicas:
+            if r.id == node_id:
+                return NodeTelemetry(
+                    node_id, replica=r, transport=r.transport,
+                    tracer=r.tracer,
+                )
+        for c in self.clients:
+            if c.id == node_id:
+                return NodeTelemetry(
+                    node_id, client=c, transport=c.transport,
+                    tracer=c.tracer,
+                )
+        raise KeyError(node_id)
+
+    def attach_tracers(self, sample_mod: int = 64, trace_dir: Optional[str] = None):
+        """Give every replica AND client a RequestTracer with the same
+        deterministic sampling, so a sampled request's lifecycle exists
+        at every hop and joins by request id. Returns {node_id: tracer}.
+        trace_dir=None keeps events in the in-memory rings only."""
+        import os
+
+        from .telemetry import RequestTracer
+
+        tracers = {}
+        for node in [*self.replicas, *self.clients]:
+            path = (
+                os.path.join(trace_dir, f"{node.id}.trace.jsonl")
+                if trace_dir
+                else None
+            )
+            tracers[node.id] = node.tracer = RequestTracer(
+                node.id, sample_mod=sample_mod, path=path
+            )
+        return tracers

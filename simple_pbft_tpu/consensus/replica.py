@@ -168,6 +168,11 @@ class Replica:
         self.pending_sync: Optional[Tuple[int, str]] = None  # (seq, digest)
         self.metrics: Dict[str, int] = defaultdict(int)
         self.stats = ReplicaStats()  # histograms: sweep/verify/commit
+        # sampled phase-level request tracing (telemetry.RequestTracer):
+        # attached after construction by node.py / committee / bench; all
+        # hooks are no-ops while None, so steady-state cost is one
+        # attribute check per event
+        self.tracer = None
         self._replica_set = frozenset(cfg.replica_ids)
         self._running = False
         self._task: Optional[asyncio.Task] = None
@@ -937,6 +942,15 @@ class Replica:
                 # floor and reply cache are checkpoint state)
                 await self._send_superseded(self.view, self.stable_seq, req)
             return
+        if self.tracer is not None and (
+            rid := self.tracer.rid_if_sampled(req.client_id, req.timestamp)
+        ):
+            # lifecycle phase 1: the request entered this replica fresh
+            self.tracer.emit(
+                "request", rid,
+                role="primary" if self.is_primary else "backup",
+                view=self.view,
+            )
         if self.is_primary:
             self.seen_requests[key] = 0  # 0 = queued, not yet assigned
             self.pending_requests.append(req)
@@ -1055,6 +1069,10 @@ class Replica:
                 # and its decode so execution skips the third validation
                 self.store_block(msg.seq, msg.digest, msg.block)
                 self._remember_block(msg.digest, reqs)
+                if self.tracer is not None:
+                    # bind sampled requests to (view, seq, digest) and
+                    # stamp their pre_prepare phase
+                    self.tracer.note_block(msg.view, msg.seq, msg.digest, reqs)
         elif isinstance(msg, Prepare):
             actions = inst.on_prepare(msg)
         else:
@@ -1191,6 +1209,9 @@ class Replica:
         if isinstance(act, SendPrepare):
             await self._send_vote(Prepare, "prepare", act)
         elif isinstance(act, SendCommit):
+            if self.tracer is not None:
+                # a SendCommit action means the slot just PREPARED here
+                self.tracer.slot_event("prepare", act.view, act.seq)
             await self._send_vote(Commit, "commit", act)
         elif isinstance(act, ExecuteBlock):
             if act.seq <= self.executed_seq:
@@ -1199,6 +1220,9 @@ class Replica:
                 # at the cert's h) must not park a stale entry in `ready`
                 self.metrics["stale_execute_dropped"] += 1
                 return
+            if self.tracer is not None:
+                # an ExecuteBlock action means a commit certificate formed
+                self.tracer.slot_event("commit", act.view, act.seq)
             self.ready[act.seq] = act
             # committee-liveness signal (failover deferral): an
             # ExecuteBlock action means a commit certificate formed for
@@ -1286,6 +1310,16 @@ class Replica:
                     continue
                 result = self.app.apply(req.operation)
                 self.metrics["committed_requests"] += 1
+                # one hash decides sampling for BOTH execute and reply
+                trace_rid = (
+                    self.tracer.rid_if_sampled(req.client_id, req.timestamp)
+                    if self.tracer is not None
+                    else None
+                )
+                if trace_rid:
+                    self.tracer.emit(
+                        "execute", trace_rid, view=act.view, seq=act.seq
+                    )
                 reply = Reply(
                     view=act.view,
                     seq=act.seq,
@@ -1310,6 +1344,13 @@ class Replica:
                     self._auth_reply(reply)
                     self.metrics["replies_sent"] += 1
                     await self.transport.send(req.client_id, reply.to_wire())
+                    if trace_rid:
+                        self.tracer.emit(
+                            "reply", trace_rid, view=act.view, seq=act.seq
+                        )
+            if self.tracer is not None:
+                # executed: the slot's trace binding is complete
+                self.tracer.release_slot(act.view, act.seq)
             if self.executed_seq % self.cfg.checkpoint_interval == 0:
                 await self._emit_checkpoint(self.executed_seq)
             self.vc.reset()  # commits are progress: the primary is alive
@@ -1698,6 +1739,14 @@ class Replica:
                     self._remember_block(dg, reqs)
                     for inst in stalled:
                         self.metrics["holes_repaired"] += 1
+                        if self.tracer is not None:
+                            # bind the repaired slot so the commit/execute
+                            # trace events that follow adoption carry the
+                            # request ids — hole repair happens exactly in
+                            # the degraded windows traces must explain
+                            self.tracer.note_block(
+                                inst.view, inst.seq, dg, reqs
+                            )
                         for act in inst.adopt_block(block):
                             if isinstance(act, ExecuteBlock):
                                 await self._perform(act)

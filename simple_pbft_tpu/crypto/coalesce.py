@@ -140,6 +140,14 @@ class VerifyService:
         self.cpu_reroute_passes = 0
         self.cpu_reroute_items = 0
         self.late_device_completions = 0
+        # quarantine lifecycle as counters (telemetry plane): an ENTRY is
+        # a healthy->quarantined transition (a watchdog trip while
+        # already benched only extends the bench), a RECOVERY is a device
+        # pass completing within deadline while the quarantine/backoff
+        # ladder was still armed — together with quarantine_probes these
+        # make enter -> probe -> recover observable in snapshots
+        self.quarantine_entries = 0
+        self.quarantine_recoveries = 0
 
     @property
     def rtt_ms(self) -> float:
@@ -246,6 +254,43 @@ class VerifyService:
 
     def verify_batch(self, items: Sequence[BatchItem]) -> List[bool]:
         return self.submit(items).result()
+
+    def snapshot(self) -> dict:
+        """One-call export of the service's overload/quarantine surface
+        for the telemetry plane (simple_pbft_tpu/telemetry.py): live
+        queue depth, routing counters, watchdog/quarantine lifecycle,
+        and the adaptive estimates. Counters are GIL-atomic ints; only
+        the pending/inflight pair is read under the lock so depth and
+        in-flight passes are a consistent cut."""
+        with self._cond:
+            pending = self._pending_items
+            inflight = self._inflight
+        return {
+            "name": self.name,
+            "degraded": self.degraded,
+            "quarantined": self.quarantined,
+            "pending_items": pending,
+            "inflight_passes": inflight,
+            "max_pending": self._max_pending,
+            "max_pending_seen": self.max_pending_seen,
+            "overload_rejections": self.overload_rejections,
+            "overload_rejected_items": self.overload_rejected_items,
+            "watchdog_failovers": self.watchdog_failovers,
+            "quarantine_entries": self.quarantine_entries,
+            "quarantine_probes": self.quarantine_probes,
+            "quarantine_recoveries": self.quarantine_recoveries,
+            "cpu_reroute_passes": self.cpu_reroute_passes,
+            "cpu_reroute_items": self.cpu_reroute_items,
+            "late_device_completions": self.late_device_completions,
+            "device_passes": self.device_passes,
+            "device_pass_items": self.device_pass_items,
+            "cpu_passes": self.cpu_passes,
+            "cpu_pass_items": self.cpu_pass_items,
+            "max_coalesced": self.max_coalesced,
+            "coalesced_submissions": self.coalesced_submissions,
+            "rtt_ms_ema": round(self.rtt_ms, 3),
+            "cpu_rate_ema": round(self._cpu_rate_ema, 1),
+        }
 
     def close(self) -> None:
         with self._cond:
@@ -430,6 +475,13 @@ class VerifyService:
                 self._resolve(subs, verdicts)
                 # a completed pass within deadline is proof of device
                 # health: end any quarantine and reset the re-probe ladder
+                if (
+                    self._quarantined_until
+                    or self._quarantine_backoff != self._quarantine_base
+                ):
+                    # the ladder was armed (benched now, or a post-expiry
+                    # probe): this pass is the recovery transition
+                    self.quarantine_recoveries += 1
                 self._quarantined_until = 0.0
                 self._quarantine_backoff = self._quarantine_base
             with self._cond:
@@ -481,6 +533,7 @@ class VerifyService:
         box["late"] = True  # benign race with done.set(): see below
         self.watchdog_failovers += 1
         now = time.monotonic()
+        was_quarantined = now < self._quarantined_until
         self._quarantined_until = now + self._quarantine_backoff
         self._quarantine_backoff = min(
             self._quarantine_cap, self._quarantine_backoff * 2
@@ -490,11 +543,18 @@ class VerifyService:
         if done.is_set():
             # the finisher landed in the instant between wait() expiry
             # and the late-marker: its result is still good — use it and
-            # withdraw the quarantine we just armed
+            # withdraw the quarantine we just armed. Withdraw the backoff
+            # doubling too: counting neither an entry nor (via the
+            # armed-ladder check in _complete_loop) a recovery keeps the
+            # lifecycle counters paired for snapshot consumers.
             self._quarantined_until = 0.0
+            if not was_quarantined:
+                self._quarantine_backoff = self._quarantine_base
             if "e" in box:
                 raise box["e"]
             return box["r"]
+        if not was_quarantined:
+            self.quarantine_entries += 1  # healthy -> quarantined
         batch: List[BatchItem] = []
         for items, _fut in subs:
             batch.extend(items)

@@ -101,7 +101,14 @@ class Histogram:
 
     def summary(self) -> Dict[str, float]:
         if self.count == 0:
-            return {"count": 0}
+            # full zeroed schema, not a bare {"count": 0}: snapshot
+            # consumers (telemetry plane, pbft_top, bench joins) index
+            # p50/p99 unconditionally and must never key-error on an
+            # idle node (ISSUE 2 satellite)
+            return {
+                "count": 0, "mean": 0.0, "min": 0.0, "max": 0.0,
+                "p50": 0.0, "p90": 0.0, "p99": 0.0,
+            }
         return {
             "count": self.count,
             "mean": round(self.total / self.count, 3),
@@ -134,17 +141,22 @@ class ReplicaStats:
             else 0.0
         )
 
+    def snapshot(self, metrics: Optional[Dict[str, int]] = None) -> Dict:
+        """The histogram/rate surface as one dict — the shape the
+        telemetry plane embeds in every /metrics.json and flight-recorder
+        frame (metrics included only when the caller passes them)."""
+        doc = {
+            "uptime_s": round(time.perf_counter() - self._started, 1),
+            "sweep_size": self.sweep_size.summary(),
+            "sweep_ms": self.sweep_ms.summary(),
+            "verify_ms": self.verify_ms.summary(),
+            "verify_per_s": round(self.verifies_per_sec(), 1),
+            "commit_ms": self.commit_ms.summary(),
+        }
+        if metrics is not None:
+            doc["metrics"] = dict(sorted(metrics.items()))
+        return doc
+
     def dump(self, metrics: Dict[str, int]) -> str:
         """One JSON line a human (or the driver) can steer perf work with."""
-        return json.dumps(
-            {
-                "uptime_s": round(time.perf_counter() - self._started, 1),
-                "metrics": dict(sorted(metrics.items())),
-                "sweep_size": self.sweep_size.summary(),
-                "sweep_ms": self.sweep_ms.summary(),
-                "verify_ms": self.verify_ms.summary(),
-                "verify_per_s": round(self.verifies_per_sec(), 1),
-                "commit_ms": self.commit_ms.summary(),
-            },
-            sort_keys=True,
-        )
+        return json.dumps(self.snapshot(metrics), sort_keys=True)

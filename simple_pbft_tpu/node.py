@@ -81,7 +81,33 @@ def make_verifier(
     raise SystemExit(f"unknown verifier backend: {name}")
 
 
+def _dump_final(node_id: str, replica, transport) -> None:
+    """Shutdown dump: counters + sweep/verify/commit histograms as one
+    JSON line each — the observability the perf work steers by (VERDICT
+    weak #8). Called from run_node's ``finally`` so a FATAL EXCEPTION
+    leaves the same post-mortem a clean SIGTERM would have (pre-ISSUE-2,
+    a crash lost everything)."""
+    logging.info("%s: stats %s", node_id, replica.stats.dump(replica.metrics))
+    logging.info(
+        "%s: transport %s", node_id, dict(getattr(transport, "metrics", {}))
+    )
+    svc = replica.verifier
+    if hasattr(svc, "snapshot"):
+        # overload-resilience counters (crypto/coalesce.py): was this run
+        # ever shedding, did the device watchdog fire, how deep did the
+        # pending pile get — the post-mortem for any degraded window
+        logging.info("%s: verify service %s", node_id, svc.snapshot())
+
+
 async def run_node(args) -> None:
+    from .telemetry import (
+        FlightRecorder,
+        NodeTelemetry,
+        RequestTracer,
+        StatusServer,
+        write_status_file,
+    )
+
     dep = deploy.load(os.path.join(args.deploy_dir, "committee.json"))
     seed = deploy.read_seed(args.deploy_dir, args.id)
     transport = make_transport(args.transport, args.id, dep)
@@ -100,41 +126,68 @@ async def run_node(args) -> None:
         max_drain=args.max_drain,
         shed_watermark=args.shed_watermark,
     )
-    replica.start()
-    logging.info(
-        "%s listening on %s (verifier=%s, n=%d, f=%d)",
-        args.id, dep.addr(args.id), args.verifier, dep.cfg.n, dep.cfg.f,
-    )
-
-    stop = asyncio.Event()
-    loop = asyncio.get_running_loop()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(sig, stop.set)
-    await stop.wait()
-    await replica.stop()
-    await transport.stop()
-    # shutdown dump: counters + sweep/verify/commit histograms as one JSON
-    # line — the observability the perf work steers by (VERDICT weak #8)
-    logging.info("%s: stats %s", args.id, replica.stats.dump(replica.metrics))
-    logging.info("%s: transport %s", args.id, dict(transport.metrics))
-    svc = replica.verifier
-    if hasattr(svc, "overload_rejections"):
-        # overload-resilience counters (crypto/coalesce.py): was this run
-        # ever shedding, did the device watchdog fire, how deep did the
-        # pending pile get — the post-mortem for any degraded window
-        logging.info(
-            "%s: verify service %s",
+    log_dir = getattr(args, "resolved_log_dir", None)
+    tracer = None
+    if args.trace_sample > 0 and log_dir:
+        tracer = RequestTracer(
             args.id,
-            dict(
-                degraded=svc.degraded,
-                max_pending_seen=svc.max_pending_seen,
-                overload_rejections=svc.overload_rejections,
-                watchdog_failovers=svc.watchdog_failovers,
-                quarantine_probes=svc.quarantine_probes,
-                cpu_reroute_passes=svc.cpu_reroute_passes,
-                late_device_completions=svc.late_device_completions,
-            ),
+            sample_mod=args.trace_sample,
+            path=os.path.join(log_dir, f"{args.id}.trace.jsonl"),
         )
+        replica.tracer = tracer
+    telemetry = NodeTelemetry(
+        args.id, replica=replica, transport=transport, tracer=tracer
+    )
+    status = None
+    recorder = None
+    try:
+        replica.start()
+        if args.status_port >= 0:
+            # live telemetry plane: /metrics.json /healthz /trace.json
+            status = StatusServer(telemetry, port=args.status_port)
+            await status.start()
+            if log_dir:
+                write_status_file(log_dir, args.id, status.bound_port)
+            logging.info(
+                "%s status endpoint on http://127.0.0.1:%d/metrics.json",
+                args.id, status.bound_port,
+            )
+        if log_dir and args.flight_interval > 0:
+            # flight recorder: a wedged or SIGKILLed node still leaves a
+            # snapshot timeline on disk (the r5 qc256 lesson)
+            recorder = FlightRecorder(
+                telemetry,
+                os.path.join(log_dir, f"{args.id}.flight.jsonl"),
+                interval=args.flight_interval,
+            )
+            recorder.start()
+        logging.info(
+            "%s listening on %s (verifier=%s, n=%d, f=%d)",
+            args.id, dep.addr(args.id), args.verifier, dep.cfg.n, dep.cfg.f,
+        )
+
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await replica.stop()
+        await transport.stop()
+    finally:
+        # fires on clean shutdown AND on a fatal exception out of the run
+        # loop: the stats/transport/overload dumps (plus the recorder's
+        # final frame) must not depend on an orderly exit — and no
+        # telemetry teardown failure may swallow them either
+        try:
+            if recorder is not None:
+                await recorder.stop()
+            if status is not None:
+                await status.stop()
+            if tracer is not None:
+                tracer.close()
+        except Exception:
+            logging.exception("%s: telemetry teardown failed", args.id)
+        _dump_final(args.id, replica, transport)
 
 
 def main() -> None:
@@ -179,6 +232,26 @@ def main() -> None:
         "watchdog fails the sweep over to the CPU verifier and "
         "quarantines the device path (0 disables)",
     )
+    ap.add_argument(
+        "--status-port", type=int, default=0,
+        help="live telemetry endpoint (/metrics.json, /healthz, "
+        "/trace.json) on 127.0.0.1; 0 = ephemeral port (written to "
+        "<log-dir>/<id>.status.json for pbft_top discovery), "
+        "negative = disabled (docs/OBSERVABILITY.md)",
+    )
+    ap.add_argument(
+        "--flight-interval", type=float, default=1.0,
+        help="flight recorder: seconds between telemetry snapshots "
+        "appended to <log-dir>/<id>.flight.jsonl (crash-surviving "
+        "timeline); 0 disables",
+    )
+    ap.add_argument(
+        "--trace-sample", type=int, default=128,
+        help="phase-level request tracing: keep ~1/N of requests "
+        "(deterministic by hash of (client, timestamp), so every node "
+        "samples the SAME requests); 1 = trace everything, 0 = off; "
+        "events go to <log-dir>/<id>.trace.jsonl",
+    )
     ap.add_argument("--log-level", default="INFO")
     ap.add_argument(
         "--log-dir",
@@ -194,6 +267,9 @@ def main() -> None:
     if log_dir is None:
         log_dir = os.path.join(args.deploy_dir, "log")
     setup_node_logging(args.id, log_dir or None, level=args.log_level)
+    # the telemetry plane (flight recorder, trace sink, status-file
+    # discovery) writes next to the rotating log
+    args.resolved_log_dir = log_dir or None
     asyncio.run(run_node(args))
 
 

@@ -1,0 +1,481 @@
+"""Unified per-node telemetry plane (ISSUE 2 tentpole).
+
+Before this module the system's observability was four disjoint surfaces
+— ``Replica.metrics`` counters, ``ReplicaStats`` histograms, transport
+counters, and the VerifyService's overload/quarantine state — each
+visible only as a one-shot log line at *clean shutdown* (node.py). The
+r5 qc256 wedge cost 25 minutes of blind waiting because a live (or
+SIGKILLed) node exposed nothing. This module makes the same state
+available while the run is live, three ways:
+
+- ``NodeTelemetry.snapshot()``: one dict with a stable schema
+  (``SCHEMA_VERSION``) absorbing all four surfaces;
+- ``StatusServer``: a tiny stdlib asyncio HTTP endpoint per node serving
+  ``/metrics.json`` (the snapshot), ``/healthz``, and ``/trace.json``
+  mid-run;
+- ``FlightRecorder``: periodic snapshots appended as line-flushed JSONL
+  under ``log_dir`` — a wedged or SIGKILLed node still leaves a timeline
+  (the r5 lesson);
+- ``RequestTracer``: deterministically sampled phase-level request
+  tracing (request → pre-prepare → prepare → commit → execute → reply)
+  with monotonic per-phase timestamps and view/seq/digest ids, emitted
+  as JSONL that joins across nodes and client by request id.
+
+Committee-wide rendering lives in ``tools/pbft_top.py``; the schema is
+documented in ``docs/OBSERVABILITY.md``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import os
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger("pbft.telemetry")
+
+# Bump when a snapshot/trace field is renamed or removed (additions are
+# compatible): consumers (pbft_top, bench joins) key off this.
+SCHEMA_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# per-surface snapshot helpers (each tolerates a missing/foreign object)
+# ---------------------------------------------------------------------------
+
+
+def replica_snapshot(replica) -> Dict[str, Any]:
+    """Consensus-plane state + counters + histograms for one replica."""
+    return {
+        "id": replica.id,
+        "running": bool(replica._running),
+        "view": replica.view,
+        "is_primary": replica.is_primary,
+        "in_view_change": bool(replica.vc.in_view_change),
+        "executed_seq": replica.executed_seq,
+        "stable_seq": replica.stable_seq,
+        "next_seq": replica.next_seq,
+        "max_committed_seen": replica.max_committed_seen,
+        "pending_requests": len(replica.pending_requests),
+        "relay_buffer": len(replica.relay_buffer),
+        "instances": len(replica.instances),
+        "ready_holes": len(replica.ready),
+        "metrics": dict(sorted(replica.metrics.items())),
+        "stats": replica.stats.snapshot(),
+    }
+
+
+def transport_snapshot(transport) -> Dict[str, Any]:
+    """Wire-level counters; every transport exposes a ``metrics`` dict
+    (tcp/grpc natively, local endpoints since this module landed)."""
+    return {
+        "kind": type(transport).__name__,
+        "metrics": dict(getattr(transport, "metrics", {}) or {}),
+    }
+
+
+def verify_service_snapshot(verifier) -> Dict[str, Any]:
+    """Overload/quarantine state for a coalescing VerifyService; a plain
+    CPU verifier reports just its name (nothing to overload)."""
+    snap = getattr(verifier, "snapshot", None)
+    if callable(snap):
+        return snap()
+    return {"name": getattr(verifier, "name", type(verifier).__name__)}
+
+
+def client_snapshot(client) -> Dict[str, Any]:
+    return {
+        "id": client.id,
+        "view_hint": client.view_hint,
+        "inflight": len(client._waiters),
+        "metrics": dict(sorted(client.metrics.items())),
+    }
+
+
+class NodeTelemetry:
+    """One node's unified registry: compose whatever surfaces the node
+    has (a replica node has replica+transport+verifier; a client node
+    has client+transport) into one ``snapshot()`` with a stable schema."""
+
+    def __init__(
+        self,
+        node_id: str,
+        replica=None,
+        transport=None,
+        client=None,
+        tracer: Optional["RequestTracer"] = None,
+    ) -> None:
+        self.node_id = node_id
+        self.replica = replica
+        self.transport = transport
+        self.client = client
+        self.tracer = tracer
+        self._t0 = time.monotonic()
+
+    def snapshot(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        snap: Dict[str, Any] = {
+            "schema": SCHEMA_VERSION,
+            "node": self.node_id,
+            "t_wall": round(time.time(), 3),
+            "t_mono": round(now, 3),
+            "uptime_s": round(now - self._t0, 3),
+        }
+        if self.replica is not None:
+            snap["replica"] = replica_snapshot(self.replica)
+            snap["verify"] = verify_service_snapshot(self.replica.verifier)
+        if self.transport is not None:
+            snap["transport"] = transport_snapshot(self.transport)
+        if self.client is not None:
+            snap["client"] = client_snapshot(self.client)
+        return snap
+
+    def health(self) -> Dict[str, Any]:
+        """Cheap liveness summary for /healthz: is the node's event
+        machinery up, and is anything currently degraded."""
+        degraded = False
+        running = True
+        if self.replica is not None:
+            running = bool(self.replica._running)
+            degraded = bool(self.replica.metrics.get("degraded_mode", 0))
+            svc = self.replica.verifier
+            degraded = degraded or bool(getattr(svc, "degraded", False))
+        return {
+            "ok": running,
+            "node": self.node_id,
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "degraded": degraded,
+        }
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: periodic snapshots as crash-surviving JSONL
+# ---------------------------------------------------------------------------
+
+
+class _JsonlSink:
+    """Line-flushed JSONL appender with one-backup size rotation and
+    write-failure degradation.
+
+    Telemetry must never take down the node it observes: a write error
+    (ENOSPC, log_dir removed) closes the sink and telemetry degrades to
+    its in-memory surfaces instead of raising into the consensus or
+    client hot path. Rotation (``path`` -> ``path.1``, one backup, like
+    logutil's rotating logs) bounds what a long-lived node can fill the
+    disk with."""
+
+    def __init__(self, path: str, max_bytes: int = 64 * 1024 * 1024):
+        self.path = path
+        self.max_bytes = max_bytes
+        self.write_errors = 0
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = open(path, "a", buffering=1)
+
+    def write(self, doc: Dict[str, Any]) -> None:
+        if self._fh is None:
+            return
+        try:
+            self._fh.write(json.dumps(doc, sort_keys=True) + "\n")
+            if self._fh.tell() >= self.max_bytes:
+                self._fh.close()
+                os.replace(self.path, self.path + ".1")
+                self._fh = open(self.path, "a", buffering=1)
+        except (OSError, ValueError):
+            self.write_errors += 1
+            try:
+                if self._fh is not None:
+                    self._fh.close()
+            except OSError:
+                pass
+            self._fh = None  # degraded: ring/log surfaces remain
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+
+class FlightRecorder:
+    """Append ``telemetry.snapshot()`` as one JSONL line per interval.
+
+    Lines are flushed as written (line-buffered file), so a SIGKILL or a
+    wedged event loop still leaves every completed snapshot on disk —
+    the timeline that reconstructs a degraded window post-hoc without a
+    clean shutdown."""
+
+    def __init__(self, telemetry: NodeTelemetry, path: str, interval: float = 1.0):
+        self.telemetry = telemetry
+        self.path = path
+        self.interval = interval
+        self._sink = _JsonlSink(path)
+        self._task: Optional[asyncio.Task] = None
+        self._snap_errors = 0
+
+    def record_once(self) -> None:
+        try:
+            snap = self.telemetry.snapshot()
+        except Exception:  # a snapshot bug must not kill the timeline
+            if not self._snap_errors:
+                log.exception("flight snapshot failed (logged once)")
+            self._snap_errors += 1
+            return
+        self._sink.write(snap)
+
+    async def _run(self) -> None:
+        while True:
+            self.record_once()
+            await asyncio.sleep(self.interval)
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            except Exception:  # a dead recorder must not abort shutdown
+                log.exception("flight recorder task failed")
+            self._task = None
+        self.record_once()  # final frame: the clean-shutdown state
+        self._sink.close()
+
+
+# ---------------------------------------------------------------------------
+# live HTTP exposure: /metrics.json /healthz /trace.json
+# ---------------------------------------------------------------------------
+
+
+class StatusServer:
+    """Minimal stdlib asyncio HTTP/1.0 status endpoint for one node.
+
+    Serves the unified snapshot mid-run — no framework, no threads, no
+    dependency; one short-lived connection per scrape (pbft_top, curl).
+    PBFT's security model is unchanged: the endpoint is read-only and
+    binds loopback by default."""
+
+    def __init__(
+        self,
+        telemetry: NodeTelemetry,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ) -> None:
+        self.telemetry = telemetry
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    def _route(self, path: str):
+        """Returns (status, payload dict) for one GET path."""
+        if path in ("/metrics.json", "/metrics"):
+            return 200, self.telemetry.snapshot()
+        if path == "/healthz":
+            h = self.telemetry.health()
+            return (200 if h["ok"] else 503), h
+        if path in ("/trace.json", "/trace"):
+            tracer = self.telemetry.tracer
+            if tracer is None:
+                return 404, {"error": "no tracer attached"}
+            return 200, {
+                "schema": SCHEMA_VERSION,
+                "node": self.telemetry.node_id,
+                "events": tracer.recent(),
+            }
+        return 404, {"error": f"unknown path {path!r}",
+                     "paths": ["/metrics.json", "/healthz", "/trace.json"]}
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            request = await asyncio.wait_for(reader.readline(), 5.0)
+            while True:  # drain headers; we serve GETs only
+                line = await asyncio.wait_for(reader.readline(), 5.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            parts = request.split()
+            path = parts[1].decode("ascii", "replace") if len(parts) >= 2 else "/"
+            try:
+                status, payload = self._route(path.split("?", 1)[0])
+                body = json.dumps(payload, sort_keys=True).encode()
+            except Exception:  # a snapshot bug must not kill the server
+                log.exception("status snapshot failed")
+                status, body = 500, b'{"error":"snapshot failed"}'
+            reason = {200: "OK", 404: "Not Found", 500: "Error",
+                      503: "Unavailable"}.get(status, "OK")
+            writer.write(
+                f"HTTP/1.0 {status} {reason}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + body
+            )
+            await writer.drain()
+        except (asyncio.TimeoutError, ValueError, ConnectionError, OSError):
+            # ValueError: StreamReader.readline on an over-limit line —
+            # a malformed scrape is a bad request, not a handler crash
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+
+def write_status_file(log_dir: str, node_id: str, port: int) -> str:
+    """Endpoint-discovery drop: ``<log_dir>/<node_id>.status.json`` names
+    the live /metrics.json port so pbft_top can find a committee without
+    being handed every port by hand."""
+    os.makedirs(log_dir, exist_ok=True)
+    path = os.path.join(log_dir, f"{node_id}.status.json")
+    with open(path, "w") as fh:
+        json.dump(
+            {"node": node_id, "host": "127.0.0.1", "port": port,
+             "pid": os.getpid(), "schema": SCHEMA_VERSION},
+            fh,
+        )
+    return path
+
+
+# ---------------------------------------------------------------------------
+# sampled phase-level request tracing
+# ---------------------------------------------------------------------------
+
+
+def request_id(client_id: str, timestamp: int) -> str:
+    """The cross-node join key: a request is (client, timestamp)
+    everywhere in the protocol, so the trace id is exactly that."""
+    return f"{client_id}:{timestamp}"
+
+
+def trace_sampled(client_id: str, timestamp: int, sample_mod: int) -> bool:
+    """Deterministic sampling by hash of (client_id, timestamp) — never
+    ``random``: every node (and the client) makes the SAME decision for
+    a request, so a sampled request's events exist at every hop and join
+    into a complete lifecycle. sample_mod N keeps ~1/N of requests;
+    1 keeps everything; <= 0 keeps nothing."""
+    if sample_mod <= 0:
+        return False
+    if sample_mod == 1:
+        return True
+    h = hashlib.sha256(request_id(client_id, timestamp).encode()).digest()
+    return int.from_bytes(h[:8], "big") % sample_mod == 0
+
+
+class RequestTracer:
+    """Per-node emitter for sampled request lifecycle events.
+
+    Events carry both wall-clock (``t_wall`` — joins across nodes) and
+    monotonic (``t_mono`` — exact per-phase deltas within a node)
+    timestamps, plus view/seq/digest once the request is bound to a
+    slot. Sinks: an in-memory ring (served at /trace.json, read by
+    tests) and optionally a line-flushed JSONL file under log_dir.
+
+    Phases stamped by the runtime:
+      client:  submit -> retransmit* -> accepted
+      replica: request -> pre_prepare -> prepare -> commit -> execute -> reply
+    """
+
+    MAX_SLOTS = 1024  # sampled (view, seq) -> request-id bindings kept
+
+    def __init__(
+        self,
+        node_id: str,
+        sample_mod: int = 64,
+        path: Optional[str] = None,
+        ring: int = 1024,
+    ) -> None:
+        self.node_id = node_id
+        self.sample_mod = sample_mod
+        self._ring: deque = deque(maxlen=ring)
+        self._slots: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._sink = _JsonlSink(path) if path else None
+        self.events_emitted = 0
+
+    def rid_if_sampled(self, client_id: str, timestamp: int) -> Optional[str]:
+        """The request id when sampled, else None — the one-call shape
+        the hot paths use (decision + id together, one sampling rule:
+        ``trace_sampled``)."""
+        if trace_sampled(client_id, timestamp, self.sample_mod):
+            return request_id(client_id, timestamp)
+        return None
+
+    def emit(self, phase: str, rid: str, **fields) -> None:
+        ev: Dict[str, Any] = {
+            "evt": "trace",
+            "schema": SCHEMA_VERSION,
+            "node": self.node_id,
+            "rid": rid,
+            "phase": phase,
+            "t_wall": time.time(),
+            "t_mono": time.monotonic(),
+        }
+        for k, v in fields.items():
+            if v is not None:
+                ev[k] = v
+        self._ring.append(ev)
+        self.events_emitted += 1
+        if self._sink is not None:
+            self._sink.write(ev)  # degrades to ring-only on write failure
+
+    # -- slot binding: phase events are per-(view, seq), requests ride them
+
+    def note_block(self, view: int, seq: int, digest: str, reqs) -> None:
+        """An admitted pre-prepare binds its block's sampled requests to
+        (view, seq, digest): emit their pre_prepare events and remember
+        the binding so later slot-level phases fan out to them."""
+        rids = [
+            rid
+            for r in reqs
+            if (rid := self.rid_if_sampled(r.client_id, r.timestamp))
+        ]
+        if not rids:
+            return
+        key = (view, seq)
+        if key not in self._slots and len(self._slots) >= self.MAX_SLOTS:
+            self._slots.popitem(last=False)
+        self._slots[key] = (digest, rids)
+        for rid in rids:
+            self.emit("pre_prepare", rid, view=view, seq=seq, digest=digest)
+
+    def slot_event(self, phase: str, view: int, seq: int) -> None:
+        ent = self._slots.get((view, seq))
+        if ent is None:
+            return
+        digest, rids = ent
+        for rid in rids:
+            self.emit(phase, rid, view=view, seq=seq, digest=digest)
+
+    def release_slot(self, view: int, seq: int) -> None:
+        self._slots.pop((view, seq), None)
+
+    def recent(self) -> List[Dict[str, Any]]:
+        return list(self._ring)
+
+    def close(self) -> None:
+        if self._sink is not None:
+            self._sink.close()
+            self._sink = None

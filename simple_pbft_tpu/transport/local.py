@@ -78,20 +78,30 @@ class LocalEndpoint:
         self.node_id = node_id
         self.net = net
         self.queue = net.queues[node_id]
+        # same counter surface as the TCP/gRPC transports so the
+        # telemetry plane reads every deployment flavor identically
+        # (drops are network-wide on a LocalNetwork; see net.dropped)
+        self.metrics: Dict[str, int] = {"sent": 0, "recv": 0}
 
     async def send(self, dest: str, raw: bytes) -> None:
+        self.metrics["sent"] += 1
         await self.net._deliver(self.node_id, dest, raw)
 
     async def broadcast(self, raw: bytes, dests: Iterable[str]) -> None:
         for dest in dests:
             if dest != self.node_id:
+                self.metrics["sent"] += 1
                 await self.net._deliver(self.node_id, dest, raw)
 
     async def recv(self) -> bytes:
-        return await self.queue.get()
+        raw = await self.queue.get()
+        self.metrics["recv"] += 1
+        return raw
 
     def recv_nowait(self) -> Optional[bytes]:
         try:
-            return self.queue.get_nowait()
+            raw = self.queue.get_nowait()
         except asyncio.QueueEmpty:
             return None
+        self.metrics["recv"] += 1
+        return raw

@@ -22,7 +22,13 @@ def test_histogram_summary():
     assert s["min"] == 0.5 and s["max"] == 100
     assert 0 < s["p50"] <= 8
     assert s["p99"] >= s["p50"]
-    assert Histogram().summary() == {"count": 0}
+    # empty histograms emit the FULL zeroed schema (ISSUE 2 satellite):
+    # snapshot consumers index p50/p99 unconditionally on idle nodes
+    empty = Histogram().summary()
+    assert empty == {
+        "count": 0, "mean": 0.0, "min": 0.0, "max": 0.0,
+        "p50": 0.0, "p90": 0.0, "p99": 0.0,
+    }
 
 
 def test_replica_stats_dump_is_json():

@@ -220,6 +220,46 @@ def test_small_sweeps_not_serialized_behind_big_cpu_reroute():
     svc.close()
 
 
+def test_quarantine_lifecycle_observable_in_snapshot():
+    """ISSUE 2 satellite: enter-quarantine -> probe -> recover is
+    observable as counter/state transitions through the unified
+    snapshot, not just internal fields."""
+    dev = FakeDevice(gate=True)
+    svc = VerifyService(
+        dev, cpu=FakeCpu(), cpu_cutoff=0, dispatch_deadline=0.1,
+        quarantine_base=0.2, quarantine_cap=5.0,
+    )
+    s0 = svc.snapshot()
+    assert s0["quarantine_entries"] == 0
+    assert s0["quarantine_recoveries"] == 0
+    assert not s0["quarantined"] and not s0["degraded"]
+    assert s0["pending_items"] == 0
+
+    # ENTER: a stalled device pass trips the watchdog
+    assert svc.submit(_items(300)).result(10) == [True] * 300
+    s1 = svc.snapshot()
+    assert s1["quarantine_entries"] == 1
+    assert s1["watchdog_failovers"] == 1
+    assert s1["quarantined"] and s1["degraded"]
+    assert s1["quarantine_recoveries"] == 0
+
+    dev.release()  # device healthy again
+    time.sleep(0.45)  # quarantine window (0.2 s, late-lift aside) expires
+    # PROBE: the next big pile touches the device again...
+    assert svc.submit(_items(300, tag=b"p")).result(10) == [True] * 300
+    # ...and RECOVER: the in-deadline completion resets the ladder
+    for _ in range(200):
+        s2 = svc.snapshot()
+        if s2["quarantine_recoveries"]:
+            break
+        time.sleep(0.01)
+    assert s2["quarantine_probes"] >= 1
+    assert s2["quarantine_recoveries"] == 1
+    assert not s2["quarantined"]
+    assert s2["quarantine_entries"] == 1  # no new entry on the way out
+    svc.close()
+
+
 # ---------------------------------------------------------------------------
 # replica priority shedding
 # ---------------------------------------------------------------------------

@@ -1,0 +1,210 @@
+#!/usr/bin/env python3
+"""pbft_top: one table for a whole committee's live telemetry.
+
+Scrapes every node's /metrics.json status endpoint (or tails its
+flight-recorder JSONL when the process is unreachable — wedged, SIGKILLed,
+or just not serving) and renders committee-wide quorum progress, verify
+queue depth, and shed/degraded/quarantine state. The r5 qc256 wedge took
+25 minutes of blind waiting to diagnose; with this it is one glance:
+every row quarantined, verify queue pinned at cap, exec frontier flat.
+
+Sources (combine freely; endpoint wins over flight file for a node):
+  --endpoints 127.0.0.1:9100,127.0.0.1:9101   explicit scrape targets
+  --log-dir DIR    discover *.status.json endpoint drops AND
+                   *.flight.jsonl timelines written by node.py / bench
+  --flight-dir DIR alias of --log-dir for bench --flight-dir output
+
+Usage:
+  python tools/pbft_top.py --log-dir dep/log              # live loop
+  python tools/pbft_top.py --endpoints 127.0.0.1:9100 --once --json
+  python tools/pbft_top.py --flight-dir /tmp/flight --once  # post-mortem
+
+Stdlib only (urllib); schema in docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+COLUMNS = (
+    "NODE", "SRC", "VIEW", "ROLE", "EXEC", "STABLE", "BACKLOG", "VQ",
+    "SHED", "DEG", "QUAR", "REJ", "WDOG", "RTTms", "REQ/s",
+)
+
+
+def scrape_endpoint(hostport: str, timeout: float = 2.0) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen(
+            f"http://{hostport}/metrics.json", timeout=timeout
+        ) as resp:
+            return json.loads(resp.read())
+    except Exception:
+        return None
+
+
+def tail_flight(path: str, max_tail: int = 256 * 1024) -> Optional[dict]:
+    """Last complete snapshot line of a flight-recorder JSONL (the file a
+    SIGKILLed node left behind)."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as fh:
+            fh.seek(max(0, size - max_tail))
+            lines = [ln for ln in fh.read().split(b"\n") if ln.strip()]
+        for ln in reversed(lines):
+            try:
+                return json.loads(ln)
+            except ValueError:
+                continue  # torn final line mid-write: take the previous
+    except OSError:
+        pass
+    return None
+
+
+def discover(log_dir: str) -> Tuple[List[str], Dict[str, str]]:
+    """(endpoints, {node: flight_path}) from a node/bench log directory."""
+    endpoints = []
+    for path in sorted(glob.glob(os.path.join(log_dir, "*.status.json"))):
+        try:
+            doc = json.load(open(path))
+            endpoints.append(f"{doc.get('host', '127.0.0.1')}:{doc['port']}")
+        except (OSError, ValueError, KeyError):
+            continue
+    flights = {
+        os.path.basename(p)[: -len(".flight.jsonl")]: p
+        for p in sorted(glob.glob(os.path.join(log_dir, "*.flight.jsonl")))
+    }
+    return endpoints, flights
+
+
+def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
+                      dt: float) -> List[str]:
+    rep = snap.get("replica") or {}
+    ver = snap.get("verify") or {}
+    met = rep.get("metrics") or {}
+    committed = met.get("committed_requests", 0)
+    rate = ""
+    if prev is not None and dt > 0:
+        prev_committed = (
+            (prev.get("replica") or {}).get("metrics", {})
+            .get("committed_requests", 0)
+        )
+        rate = f"{(committed - prev_committed) / dt:.1f}"
+    backlog = rep.get("pending_requests", 0) + rep.get("relay_buffer", 0)
+    return [
+        str(snap.get("node", "?")),
+        src,
+        str(rep.get("view", "?")),
+        ("PRIM" if rep.get("is_primary")
+         else "vc" if rep.get("in_view_change") else "bkup"),
+        str(rep.get("executed_seq", "?")),
+        str(rep.get("stable_seq", "?")),
+        str(backlog),
+        str(ver.get("pending_items", "")),
+        str(met.get("messages_shed", 0)),
+        "*" if (met.get("degraded_mode") or ver.get("degraded")) else "",
+        "*" if ver.get("quarantined") else "",
+        str(ver.get("overload_rejections", "")),
+        str(ver.get("watchdog_failovers", "")),
+        (f"{ver['rtt_ms_ema']:.0f}" if "rtt_ms_ema" in ver else ""),
+        rate,
+    ]
+
+
+def render(rows: List[List[str]]) -> str:
+    table = [list(COLUMNS)] + rows
+    widths = [max(len(r[i]) for r in table) for i in range(len(COLUMNS))]
+    lines = [
+        "  ".join(cell.ljust(w) for cell, w in zip(r, widths)).rstrip()
+        for r in table
+    ]
+    execs = [int(r[4]) for r in rows if r[4].isdigit()]
+    if execs:
+        lines.append(
+            f"-- committee: {len(rows)} nodes, exec frontier "
+            f"min={min(execs)} max={max(execs)} (spread {max(execs) - min(execs)}), "
+            f"degraded={sum(1 for r in rows if r[9])}, "
+            f"quarantined={sum(1 for r in rows if r[10])}"
+        )
+    return "\n".join(lines)
+
+
+def gather(endpoints: List[str], flights: Dict[str, str]) -> Dict[str, Tuple[str, dict]]:
+    """node -> (source, snapshot). Endpoint scrape wins; flight tail
+    covers nodes that stopped serving (the post-mortem path)."""
+    snaps: Dict[str, Tuple[str, dict]] = {}
+    for hp in endpoints:
+        snap = scrape_endpoint(hp)
+        if snap is not None:
+            snaps[str(snap.get("node", hp))] = ("http", snap)
+    for node, path in flights.items():
+        if node in snaps:
+            continue
+        snap = tail_flight(path)
+        if snap is not None:
+            snaps[node] = ("jsonl", snap)
+    return snaps
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="committee-wide live telemetry table"
+    )
+    ap.add_argument("--endpoints", default="",
+                    help="comma-separated host:port /metrics.json targets")
+    ap.add_argument("--log-dir", default=None,
+                    help="discover *.status.json + *.flight.jsonl here")
+    ap.add_argument("--flight-dir", default=None,
+                    help="alias of --log-dir (bench --flight-dir output)")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one table and exit (no screen clearing)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit raw snapshots as JSONL instead of the table")
+    args = ap.parse_args()
+
+    endpoints = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+    prev: Dict[str, dict] = {}
+    prev_t = time.monotonic()
+    while True:
+        flights: Dict[str, str] = {}
+        found: List[str] = []
+        for d in (args.log_dir, args.flight_dir):
+            if d:
+                eps, fls = discover(d)
+                found.extend(eps)
+                flights.update(fls)
+        snaps = gather(endpoints + found, flights)
+        now = time.monotonic()
+        if not snaps:
+            print("pbft_top: no nodes found (check --endpoints/--log-dir)",
+                  file=sys.stderr)
+            if args.once:
+                sys.exit(1)
+        elif args.json:
+            for _, (_, snap) in sorted(snaps.items()):
+                print(json.dumps(snap, sort_keys=True))
+        else:
+            rows = [
+                row_from_snapshot(snap, src, prev.get(node), now - prev_t)
+                for node, (src, snap) in sorted(snaps.items())
+            ]
+            if not args.once:
+                print("\x1b[2J\x1b[H", end="")  # clear screen, home cursor
+                print(time.strftime("%H:%M:%S"), "pbft_top")
+            print(render(rows))
+        prev = {node: snap for node, (_, snap) in snaps.items()}
+        prev_t = now
+        if args.once:
+            return
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()
