@@ -520,7 +520,12 @@ async def run_config(
             svc_quarantine_probes=service.quarantine_probes,
             svc_cpu_reroute_passes=service.cpu_reroute_passes,
             svc_cpu_reroute_items=service.cpu_reroute_items,
+            svc_cpu_reroute_chunks=service.cpu_reroute_chunks,
             svc_late_device_completions=service.late_device_completions,
+            # shape stability (ISSUE 3): after warmup this must report
+            # post_warm_compiles == 0 — a nonzero value means the run
+            # paid a mid-window XLA compile (the r5 qc256 suspect)
+            svc_device_shapes=shared_verifier.shape_snapshot(),
         )
 
     telemetry_end = _committee_telemetry(
@@ -577,6 +582,14 @@ async def run_config(
     rec.update(shed_info)
     rec.update(verify_stats)
     rec.update(crash_info)
+    # QC-plane fast path (ISSUE 3): certificate-verify lane occupancy —
+    # batch sizes, pairing latency, queue pressure. Present whenever any
+    # QC was verified this process (qc_mode configs; None otherwise).
+    from simple_pbft_tpu.consensus import qc as qc_lane_mod
+
+    lane_snap = qc_lane_mod.lane_snapshot()
+    if lane_snap is not None:
+        rec["qc_lane"] = lane_snap
     # start/end unified snapshots: the cell carries the telemetry that
     # explains it (e.g. a low committed_req_s with end.verify.quarantined
     # true and messages_shed high IS the diagnosis, no log forensics)

@@ -1104,8 +1104,14 @@ class Replica:
         cert = qc_mod.build_qc(phase, view, seq, digest, shares, self.cfg.quorum)
         if cert is None:
             return None, set()
-        if await asyncio.to_thread(qc_mod.verify_qc, self.cfg, cert):
-            return cert, set()
+        try:
+            if await qc_mod.verify_qc_async(self.cfg, cert):
+                return cert, set()
+        except qc_mod.QcLaneOverloaded:
+            # lane at cap: don't blame shares — aggregation retries on
+            # the next share arrival once the pile drains
+            self.metrics["qc_shed_overload"] += 1
+            return None, set()
         self.metrics["qc_aggregate_failed"] += 1
         good = await asyncio.to_thread(
             qc_mod.bisect_bad_shares, self.cfg, phase, view, seq, digest, shares
@@ -1115,9 +1121,11 @@ class Replica:
         if len(good) < self.cfg.quorum:
             return None, bad
         cert = qc_mod.build_qc(phase, view, seq, digest, good, self.cfg.quorum)
-        if cert is None or not await asyncio.to_thread(
-            qc_mod.verify_qc, self.cfg, cert
-        ):
+        try:
+            if cert is None or not await qc_mod.verify_qc_async(self.cfg, cert):
+                return None, bad
+        except qc_mod.QcLaneOverloaded:
+            self.metrics["qc_shed_overload"] += 1
             return None, bad
         return cert, bad
 
@@ -1183,14 +1191,25 @@ class Replica:
             self.metrics["out_of_window"] += 1
             return
         # rate-bound the expensive pairing per sender: a faulty replica
-        # streaming distinct bogus aggregates (each a fresh ~0.8 s check,
-        # uncacheable by construction) must not monopolize the verify
-        # thread pool. Honest senders never accumulate failures.
+        # streaming distinct bogus aggregates (each a fresh pairing,
+        # uncacheable by construction) must not monopolize the QC lane.
+        # Honest senders never accumulate failures.
         bad_key = (msg.sender, msg.view)
         if self._qc_bad_by_sender.get(bad_key, 0) >= 8:
             self.metrics["qc_sender_muted"] += 1
             return
-        if not await asyncio.to_thread(qc_mod.verify_qc, self.cfg, msg):
+        try:
+            # off-loop batched check (qc.QcVerifyLane): every replica's
+            # pending certs coalesce into one RLC multi-pairing, and a
+            # 60 ms pairing never rides the Ed25519 executor threads
+            ok = await qc_mod.verify_qc_async(self.cfg, msg)
+        except qc_mod.QcLaneOverloaded:
+            # lane at cap: shed this certificate, not the sender's
+            # reputation — QCs are self-certifying and re-arrive via
+            # rebroadcast or the slot-probe chain once the pile drains
+            self.metrics["qc_shed_overload"] += 1
+            return
+        if not ok:
             self.metrics["bad_qc"] += 1
             self._qc_bad_by_sender[bad_key] = (
                 self._qc_bad_by_sender.get(bad_key, 0) + 1

@@ -39,6 +39,7 @@ wrong-message corruption.
 from __future__ import annotations
 
 import hashlib
+import secrets
 from typing import List, Optional, Sequence, Tuple
 
 # -- base field / curve constants -------------------------------------------
@@ -643,3 +644,126 @@ def verify_aggregate(
     if agg_pk is None:
         return False
     return pairings_equal(s, G2_GEN, hash_to_g1(msg), agg_pk)
+
+
+# -- batched aggregate verification (QC-plane fast path) ---------------------
+#
+# k pending quorum certs over the SAME signer set collapse to TWO Miller
+# loops via a random linear combination: with secret 128-bit coefficients
+# r_i drawn per check,
+#
+#     e(sum r_i * sig_i, G2) == e(sum r_i * H(m_i), agg_pk)
+#
+# holds for honest certs by bilinearity, and an invalid cert slips through
+# only if its error component happens to cancel under coefficients chosen
+# AFTER the certs were fixed — probability 2^-128 per check. Certs with
+# different signer sets group separately (two Miller loops per distinct
+# set; under consensus traffic the quorum is almost always the same 2f+1
+# replicas, so the common case is one group). A failed group check falls
+# back to halving: log2(k) RLC checks isolate one bad cert instead of k
+# full pairings (the certificate-level analog of qc.bisect_bad_shares).
+
+RLC_SCALAR_BITS = 128
+
+#: one batch entry: (signer pubkeys, signed payload, aggregate signature)
+BatchEntry = Tuple[Sequence[bytes], bytes, bytes]
+
+
+def _rlc_scalar() -> int:
+    """Secret nonzero random coefficient — must be unpredictable to the
+    cert producer or the soundness argument collapses."""
+    return 1 + secrets.randbelow((1 << RLC_SCALAR_BITS) - 1)
+
+
+def _rlc_check(pk_set: Tuple[bytes, ...], ents: List[BatchEntry]) -> bool:
+    """One RLC multi-pairing over entries sharing a signer set. False
+    means "at least one cert is bad OR an input was structurally
+    rejected" — callers split and retry, bottoming out at single-cert
+    verify_aggregate, so a structural reject can never mislabel a good
+    sibling."""
+    rands = [_rlc_scalar() for _ in ents]
+    r = _native().bls_verify_batch_rlc(
+        list(pk_set),
+        [e[1] for e in ents],
+        [e[2] for e in ents],
+        rands,
+        DST_SIG,
+    )
+    if r is not None:
+        return r
+    # pure-Python fallback (differential oracle for the native path)
+    s_acc = None
+    m_acc = None
+    for (_, msg, agg_sig), ri in zip(ents, rands):
+        sig_pt = _g1_from_bytes(agg_sig)
+        if sig_pt is None or not _subgroup_check_g1(sig_pt):
+            return False
+        s_acc = G1.add_pts(s_acc, G1.mul_pt(sig_pt, ri))
+        m_acc = G1.add_pts(m_acc, G1.mul_pt(hash_to_g1(msg), ri))
+    if s_acc is None or m_acc is None:
+        # degenerate combination (vanishing accumulator): cannot certify
+        # anything from it — force the per-cert path
+        return False
+    agg_pk = aggregate_pubkeys(pk_set)
+    if agg_pk is None:
+        return False
+    return pairings_equal(s_acc, G2_GEN, m_acc, agg_pk)
+
+
+def _resolve_group(
+    entries: Sequence[BatchEntry],
+    pk_set: Tuple[bytes, ...],
+    idxs: List[int],
+    out: List[bool],
+) -> None:
+    """Fill verdicts for one signer-set group: one RLC check when it
+    holds, halving recursion when it fails (a single bad cert in k costs
+    ~2*log2(k) batch checks, not k pairings)."""
+    if len(idxs) == 1:
+        i = idxs[0]
+        out[i] = verify_aggregate(list(pk_set), entries[i][1], entries[i][2])
+        return
+    if _rlc_check(pk_set, [entries[i] for i in idxs]):
+        for i in idxs:
+            out[i] = True
+        return
+    mid = len(idxs) // 2
+    _resolve_group(entries, pk_set, idxs[:mid], out)
+    _resolve_group(entries, pk_set, idxs[mid:], out)
+
+
+def verify_aggregates_batch(entries: Sequence[BatchEntry]) -> List[bool]:
+    """Per-cert verdicts for k pending quorum certificates, batched: 2
+    Miller loops per distinct signer set instead of 2 per cert, with a
+    halving fallback isolating bad certs when a group check fails.
+    Differentially tested against single-cert verify_aggregate
+    (tests/test_bls_batch.py)."""
+    out = [False] * len(entries)
+    groups: "dict[Tuple[bytes, ...], List[int]]" = {}
+    for i, (pks, _msg, _sig) in enumerate(entries):
+        if not pks:
+            continue  # structurally empty signer set: stays False
+        groups.setdefault(tuple(pks), []).append(i)
+    for pk_set, idxs in groups.items():
+        _resolve_group(entries, pk_set, idxs, out)
+    return out
+
+
+def verify_aggregates_all(entries: Sequence[BatchEntry]) -> bool:
+    """All-or-nothing batch check: True iff EVERY cert verifies. On any
+    group failure it returns False WITHOUT bisecting — the certificate-
+    validation path (a NEW-VIEW's embedded QCs) needs only the boolean,
+    and early rejection keeps a Byzantine certificate stuffed with
+    fabricated aggregates at one batch check, not k pairings."""
+    groups: "dict[Tuple[bytes, ...], List[BatchEntry]]" = {}
+    for ent in entries:
+        if not ent[0]:
+            return False
+        groups.setdefault(tuple(ent[0]), []).append(ent)
+    for pk_set, ents in groups.items():
+        if len(ents) == 1:
+            if not verify_aggregate(list(pk_set), ents[0][1], ents[0][2]):
+                return False
+        elif not _rlc_check(pk_set, ents):
+            return False
+    return True

@@ -139,6 +139,7 @@ class VerifyService:
         self.quarantine_probes = 0
         self.cpu_reroute_passes = 0
         self.cpu_reroute_items = 0
+        self.cpu_reroute_chunks = 0
         self.late_device_completions = 0
         # quarantine lifecycle as counters (telemetry plane): an ENTRY is
         # a healthy->quarantined transition (a watchdog trip while
@@ -196,7 +197,16 @@ class VerifyService:
         self._device.device_seconds = v
 
     def warm_for_population(self, pubkeys: Sequence[bytes], max_sweep: int) -> None:
-        self._device.warm_for_population(pubkeys, max_sweep)
+        # Shape-stable coalescing (ISSUE 3): this service folds EVERY
+        # submitter's pending sweep into one take, so the bucket set
+        # reachable through it is bounded by its own max_batch, not by
+        # one submitter's sweep bound — warming only `max_sweep` left
+        # the top buckets cold and the first busy moment compiled them
+        # mid-run (the r5 qc256 8127-item pile). Warm exactly the set
+        # a coalesced take can hit.
+        self._device.warm_for_population(
+            pubkeys, max(max_sweep, self._max_batch)
+        )
 
     def warm(self, **kw) -> None:
         self._device.warm(**kw)
@@ -265,7 +275,7 @@ class VerifyService:
         with self._cond:
             pending = self._pending_items
             inflight = self._inflight
-        return {
+        out = {
             "name": self.name,
             "degraded": self.degraded,
             "quarantined": self.quarantined,
@@ -281,6 +291,7 @@ class VerifyService:
             "quarantine_recoveries": self.quarantine_recoveries,
             "cpu_reroute_passes": self.cpu_reroute_passes,
             "cpu_reroute_items": self.cpu_reroute_items,
+            "cpu_reroute_chunks": self.cpu_reroute_chunks,
             "late_device_completions": self.late_device_completions,
             "device_passes": self.device_passes,
             "device_pass_items": self.device_pass_items,
@@ -291,6 +302,13 @@ class VerifyService:
             "rtt_ms_ema": round(self.rtt_ms, 3),
             "cpu_rate_ema": round(self._cpu_rate_ema, 1),
         }
+        # shape-stability surface of the device behind this service
+        # (TpuVerifier.shape_snapshot): after warmup post_warm_compiles
+        # must read 0 — a nonzero value mid-run IS the r5 qc256 suspect
+        shape = getattr(self._device, "shape_snapshot", None)
+        if callable(shape):
+            out["device_shapes"] = shape()
+        return out
 
     def close(self) -> None:
         with self._cond:
@@ -405,29 +423,38 @@ class VerifyService:
                         # device again: this dispatch is the re-probe
                         self.quarantine_probes += 1
                     self._inflight += 1
-            batch: List[BatchItem] = []
-            for items, _fut in subs:
-                batch.extend(items)
             self.coalesced_submissions += len(subs)
             self.max_coalesced = max(self.max_coalesced, total)
+            # the flattened batch is built only on the paths that consume
+            # it whole — the chunked reroute works from `subs` directly,
+            # so the big-pile case pays no O(total) copy in this loop
             if route_cpu:
-                if quarantined and total > self._cutoff():
-                    # big pile reforced onto the CPU by quarantine: run it
-                    # on its own thread so the dispatch loop keeps
-                    # clearing small quorum sweeps — per-pile latency
-                    # isolation, a multi-thousand-item reroute must never
-                    # serialize a 15-item quorum gate behind it
+                if total > self._cutoff():
+                    # big pile forced onto the CPU (quarantine OR the
+                    # adaptive depth-full clause): run it on its own
+                    # thread so the dispatch loop keeps clearing small
+                    # quorum sweeps, and resolve submission-by-submission
+                    # in bounded chunks so early submitters inside the
+                    # take answer before the tail (ADVICE r5 — the
+                    # depth-full reroute used to run the whole pass
+                    # inline in the dispatcher, serializing every later
+                    # 15-item quorum gate behind up to max_batch items)
                     self.cpu_reroute_passes += 1
                     self.cpu_reroute_items += total
                     threading.Thread(
-                        target=self._run_cpu,
-                        args=(batch, subs),
+                        target=self._run_cpu_chunked,
+                        args=(subs,),
                         name="verify-cpu-reroute",
                         daemon=True,
                     ).start()
                 else:
-                    self._run_cpu(batch, subs)
+                    self._run_cpu(
+                        [it for items, _fut in subs for it in items], subs
+                    )
             else:
+                batch: List[BatchItem] = []
+                for items, _fut in subs:
+                    batch.extend(items)
                 t0 = time.perf_counter()
                 try:
                     finisher = self._device.dispatch_batch(batch)
@@ -555,18 +582,40 @@ class VerifyService:
             return box["r"]
         if not was_quarantined:
             self.quarantine_entries += 1  # healthy -> quarantined
-        batch: List[BatchItem] = []
-        for items, _fut in subs:
-            batch.extend(items)
         self.cpu_reroute_passes += 1
         self.cpu_reroute_items += total
         threading.Thread(
-            target=self._run_cpu,
-            args=(batch, subs),
+            target=self._run_cpu_chunked,
+            args=(subs,),
             name="verify-watchdog-failover",
             daemon=True,
         ).start()
         return None
+
+    # biggest single CPU pass a reroute may make: one submission's worst
+    # case is max_drain (4096) items, so 2048 keeps any one pass under
+    # ~100 ms on the native path while still amortizing per-call overhead
+    REROUTE_CHUNK = 2048
+
+    def _run_cpu_chunked(self, subs) -> None:
+        """Big CPU reroute: verify in bounded chunks at SUBMISSION
+        granularity, resolving each submission's future as soon as its
+        verdicts exist — a 15-item quorum sweep coalesced into the same
+        take as an 8k-item pile answers in milliseconds instead of after
+        the whole pass (ADVICE r5). Runs on a reroute thread; exceptions
+        fail only the chunk that hit them (later chunks still verify)."""
+        chunk: List[BatchItem] = []
+        chunk_subs: list = []
+        for items, fut in subs:
+            chunk.extend(items)
+            chunk_subs.append((items, fut))
+            if len(chunk) >= self.REROUTE_CHUNK:
+                self.cpu_reroute_chunks += 1
+                self._run_cpu(chunk, chunk_subs)
+                chunk, chunk_subs = [], []
+        if chunk_subs:
+            self.cpu_reroute_chunks += 1
+            self._run_cpu(chunk, chunk_subs)
 
     def _run_cpu(self, batch: List[BatchItem], subs) -> None:
         t0 = time.perf_counter()

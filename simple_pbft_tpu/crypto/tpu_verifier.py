@@ -644,6 +644,21 @@ class TpuVerifier:
         self.device_calls = 0
         self.device_items = 0
         self.device_seconds = 0.0
+        # Shape-stability accounting (ISSUE 3 tentpole). The jit
+        # signature is a function of (kernel, padded batch bucket, table
+        # capacity); a signature never dispatched before means XLA traces
+        # and compiles — 40-150 s under the device lock on a small host,
+        # which mid-run is a committee-wide stall (the r5 qc256 8127-item
+        # pile). `shape_compiles` counts first-time signatures,
+        # `post_warm_compiles` the ones AFTER warmup declared the shape
+        # set closed — the invariant is post_warm_compiles == 0, asserted
+        # by tests via this hook and exported through VerifyService
+        # snapshots for live runs.
+        self.shape_signatures: set = set()
+        self.shape_compiles = 0
+        self.post_warm_compiles = 0
+        self.bucket_hits: Dict[int, int] = {}
+        self._warm_done = False
 
     @classmethod
     def for_population(
@@ -682,6 +697,10 @@ class TpuVerifier:
             )
         top = _bucket_size(max(1, min(max_sweep, BUCKETS[-1])))
         self.warm(pubkeys=pubkeys, buckets=[b for b in BUCKETS if b <= top])
+        # the shape set is now closed: any later first-time signature is
+        # a mid-run compile — counted in post_warm_compiles and surfaced
+        # through the telemetry plane (the r5 qc256 suspect made visible)
+        self._warm_done = True
 
     def warm(
         self,
@@ -706,6 +725,39 @@ class TpuVerifier:
         dummy = BatchItem(bytes(31), b"", bytes(64))
         for b in buckets:
             self.verify_batch([dummy] * b)
+
+    def _record_shape(self, size: int) -> None:
+        """Track the jit signature this dispatch hits. Must run AFTER
+        host prep (bank lookups can grow the table capacity, which is
+        part of the signature) and records under the bank lock's
+        protection being unnecessary: GIL-atomic set/dict ops, and the
+        counters are observability, not control flow."""
+        cap = self._bank._cap if self._bank is not None else 0
+        sig = (self._mode, self._window, size, cap)
+        self.bucket_hits[size] = self.bucket_hits.get(size, 0) + 1
+        if sig not in self.shape_signatures:
+            self.shape_signatures.add(sig)
+            self.shape_compiles += 1
+            if self._warm_done:
+                self.post_warm_compiles += 1
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "TpuVerifier: fresh jit signature %s AFTER warmup — "
+                    "mid-run XLA compile (extend warm_for_population's "
+                    "bucket set or initial_keys)", sig,
+                )
+
+    def shape_snapshot(self) -> dict:
+        """Shape-stability counters for the telemetry plane: after
+        warmup, post_warm_compiles must stay 0 (asserted in tests via
+        this hook; scraped live via VerifyService.snapshot)."""
+        return {
+            "warmed": self._warm_done,
+            "shape_compiles": self.shape_compiles,
+            "post_warm_compiles": self.post_warm_compiles,
+            "bucket_hits": {str(k): v for k, v in sorted(self.bucket_hits.items())},
+        }
 
     def verify_batch(self, items: Sequence[BatchItem]) -> List[bool]:
         return self.dispatch_batch(items)()
@@ -755,6 +807,7 @@ class TpuVerifier:
         else:
             prep = prepare_batch(items).padded(size)
             args = prep.arrays()
+        self._record_shape(size)
         with _DEVICE_LOCK:
             t0 = time.perf_counter()
             dev_out = self._fn(*args)  # async: enqueue only

@@ -531,10 +531,36 @@ class ViewChange(Message):
     checkpoint_proof: List[Dict[str, Any]] = field(default_factory=list)
     prepared_proofs: List[Dict[str, Any]] = field(default_factory=list)
 
+    def signing_payload(self) -> bytes:
+        """Sign with the checkpoint proof DETACHED (the same move as
+        PrePrepare's detached block). The proof is self-certifying —
+        every embedded Checkpoint carries its own Ed25519 signature and
+        a CheckpointQC its own BLS aggregate, all re-verified by the
+        receiver — while the CLAIM it supports (``stable_seq``) stays
+        under this envelope signature. Detaching lets the NEW-VIEW
+        assembler deduplicate the 2f+1 near-identical proofs across its
+        embedded VIEW-CHANGE set (VERDICT weak #5: 237-419 KB NEW-VIEWs
+        at n=64, dominated by repeated checkpoint certificates) without
+        breaking any sender's signature. A relayer substituting a
+        different valid proof for the same h changes nothing the
+        protocol consumes; substituting an invalid one is rejected —
+        the same outcome as dropping the message."""
+        d = self.to_dict()
+        d["sig"] = ""
+        d["checkpoint_proof"] = []
+        return canonical_json(d)
+
 
 @dataclass
 class NewView(Message):
-    """NEW-VIEW: the new primary's certificate installing view v+1."""
+    """NEW-VIEW: the new primary's certificate installing view v+1.
+
+    ``checkpoint_pool`` deduplicates checkpoint certificates across the
+    embedded VIEW-CHANGE set: each entry is ``{"seq": h, "proof":
+    [...]}`` and every shipped VIEW-CHANGE whose ``checkpoint_proof``
+    arrives empty refills from the pool entry for its ``stable_seq``
+    (viewchange.validate_new_view). 2f+1 replicas proving the same h
+    then cost ONE copy of the certificate instead of 2f+1."""
 
     KIND: ClassVar[str] = "newview"
     MAX_WIRE_BYTES: ClassVar[int] = 256 * 1024 * 1024
@@ -542,6 +568,7 @@ class NewView(Message):
     new_view: int = 0
     viewchange_proof: List[Dict[str, Any]] = field(default_factory=list)
     pre_prepares: List[Dict[str, Any]] = field(default_factory=list)
+    checkpoint_pool: List[Dict[str, Any]] = field(default_factory=list)
 
 
 @dataclass

@@ -128,6 +128,10 @@ def _configure_bls(lib):
         u8p, i64, u8p, i64, u8p, u8p, i64,
     ]
     lib.bls_verify_aggregate.restype = ctypes.c_int
+    lib.bls_verify_batch_rlc.argtypes = [
+        u8p, i64, u8p, _i64p, i64, u8p, u8p, u8p, i64,
+    ]
+    lib.bls_verify_batch_rlc.restype = ctypes.c_int
     lib.bls_sign.argtypes = [u8p, u8p, i64, u8p, i64, u8p]
     lib.bls_sign.restype = ctypes.c_int
     lib.bls_pubkey.argtypes = [u8p, u8p]
@@ -289,6 +293,44 @@ def bls_verify_aggregate(
     cat = b"".join(pubkeys)
     r = lib.bls_verify_aggregate(
         _cbuf(cat), len(pubkeys), _cbuf(msg), len(msg), _cbuf(sig),
+        _cbuf(dst), len(dst),
+    )
+    return bool(r)
+
+
+def bls_verify_batch_rlc(
+    pubkeys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    rands: Sequence[int],
+    dst: bytes,
+) -> Optional[bool]:
+    """Native random-linear-combination batch verify of k aggregate
+    signatures sharing ONE signer set (the QC-plane fast path): checks
+    e(sum r_i*sig_i, G2) == e(sum r_i*H(m_i), agg_pk) with two Miller
+    loops total. True = every cert in the batch is valid; False = the
+    batch fails (the caller bisects); None = library unavailable."""
+    k = len(msgs)
+    if (
+        k == 0
+        or len(sigs) != k
+        or len(rands) != k
+        or not pubkeys
+        or any(len(p) != 192 for p in pubkeys)
+        or any(len(s) != 96 for s in sigs)
+        or any(not 0 < r < (1 << 256) for r in rands)
+    ):
+        return False
+    lib = _load_bls()
+    if lib is None:
+        return None
+    cat_msgs, offs = b"".join(msgs), np.zeros(k + 1, dtype=np.int64)
+    np.cumsum([len(m) for m in msgs], out=offs[1:])
+    r = lib.bls_verify_batch_rlc(
+        _cbuf(b"".join(pubkeys)), len(pubkeys),
+        _cbuf(cat_msgs), np.ascontiguousarray(offs), k,
+        _cbuf(b"".join(sigs)),
+        _cbuf(b"".join(ri.to_bytes(32, "big") for ri in rands)),
         _cbuf(dst), len(dst),
     )
     return bool(r)

@@ -60,18 +60,21 @@ def make_verifier(
         # and pre-pay the device compiles before serving traffic: the
         # jit signature includes the table shape, so a bank growing
         # under live traffic means minutes-long compiles mid-consensus
-        # (the round-4 consensus-on-chip zero-commit bug). max_sweep is
-        # the replica's drain bound — every bucket a live sweep can hit
-        # is warmed at boot. The VerifyService wrapper gives the node
+        # (the round-4 consensus-on-chip zero-commit bug). The warm runs
+        # THROUGH the service (shape-stable coalescing, ISSUE 3): a
+        # coalesced take can reach the service's max_batch even when one
+        # replica's drain sweep is smaller, so warming only the sweep
+        # bound left the top buckets cold — the r5 qc256 8127-item pile
+        # compiled mid-run. The VerifyService wrapper gives the node
         # async non-blocking dispatch and a CPU path for tiny sweeps
         # (one process = one replica here, so coalescing is across
         # consecutive sweeps rather than replicas).
-        return VerifyService(
-            TpuVerifier.for_population(
-                list(dep.cfg.pubkeys.values()), max_sweep=4096
-            ),
-            **svc_kw,
+        pubkeys = list(dep.cfg.pubkeys.values())
+        svc = VerifyService(
+            TpuVerifier(initial_keys=len(pubkeys) + 32), **svc_kw
         )
+        svc.warm_for_population(pubkeys, max_sweep=4096)
+        return svc
     if name == "cpu":
         return best_cpu_verifier()
     if name == "cpu-pure":

@@ -96,6 +96,15 @@ def client_snapshot(client) -> Dict[str, Any]:
     }
 
 
+def qc_lane_snapshot() -> Optional[Dict[str, Any]]:
+    """Counters of the process-wide QC verify lane (consensus/qc.py:
+    queue depth, batch size, pairing latency), or None when no
+    certificate was ever submitted — non-QC nodes carry no extra key."""
+    from .consensus import qc as qc_mod
+
+    return qc_mod.lane_snapshot()
+
+
 class NodeTelemetry:
     """One node's unified registry: compose whatever surfaces the node
     has (a replica node has replica+transport+verifier; a client node
@@ -128,6 +137,12 @@ class NodeTelemetry:
         if self.replica is not None:
             snap["replica"] = replica_snapshot(self.replica)
             snap["verify"] = verify_service_snapshot(self.replica.verifier)
+            lane = qc_lane_snapshot()
+            if lane is not None:
+                # QC-plane fast path (ISSUE 3): certificate-verify queue
+                # depth / batch size / pairing latency — process-wide,
+                # reported identically by every in-process node
+                snap["qc_lane"] = lane
         if self.transport is not None:
             snap["transport"] = transport_snapshot(self.transport)
         if self.client is not None:

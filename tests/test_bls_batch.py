@@ -1,0 +1,149 @@
+"""Batched BLS aggregate verification (QC-plane fast path, ISSUE 3).
+
+Pins the random-linear-combination multi-pairing against the single-cert
+oracle: valid batches, invalid batches, mixed batches (the halving
+fallback must isolate exactly the bad certs), adversarial shares inside
+an aggregate, signer-set grouping, structural rejects, and native/Python
+path agreement. Pure-Python pairings cost ~0.8 s each — the Python-path
+cases keep batch sizes tiny.
+"""
+
+import pytest
+
+from simple_pbft_tpu.crypto import bls
+
+MSGS = [b"qc payload %d" % i for i in range(8)]
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return [bls.keygen(bytes([i + 1]) * 32) for i in range(4)]
+
+
+def _cert(keys, msg, signers=None, forge=None):
+    """(pubkeys, msg, agg_sig) over `msg` by `signers` (index list).
+    `forge` replaces that signer's share with one over b"forged"."""
+    signers = signers if signers is not None else range(len(keys))
+    sigs = []
+    pks = []
+    for i in signers:
+        sk, pk = keys[i]
+        sigs.append(bls.sign(sk, b"forged" if i == forge else msg))
+        pks.append(pk)
+    return pks, msg, bls.aggregate_signatures(sigs)
+
+
+class _NoNative:
+    """Native library stub: every entry point reports unavailable, so
+    the module exercises its pure-Python fallback."""
+
+    @staticmethod
+    def bls_verify_one(*a, **k):
+        return None
+
+    @staticmethod
+    def bls_verify_aggregate(*a, **k):
+        return None
+
+    @staticmethod
+    def bls_verify_batch_rlc(*a, **k):
+        return None
+
+
+def test_valid_batch_matches_singles(keys):
+    entries = [_cert(keys, m) for m in MSGS[:6]]
+    out = bls.verify_aggregates_batch(entries)
+    assert out == [True] * 6
+    singles = [bls.verify_aggregate(*e) for e in entries]
+    assert out == singles
+    assert bls.verify_aggregates_all(entries) is True
+
+
+def test_mixed_batch_isolates_bad_certs(keys):
+    entries = [_cert(keys, m) for m in MSGS[:6]]
+    # cert 2: one adversarial share poisoned the aggregate (valid curve
+    # point, valid structure — only the pairing can catch it)
+    entries[2] = _cert(keys, MSGS[2], forge=1)
+    # cert 4: aggregate over the wrong message entirely
+    entries[4] = (entries[4][0], MSGS[4], _cert(keys, b"other")[2])
+    out = bls.verify_aggregates_batch(entries)
+    assert out == [True, True, False, True, False, True]
+    assert out == [bls.verify_aggregate(*e) for e in entries]
+    assert bls.verify_aggregates_all(entries) is False
+
+
+def test_structural_rejects_do_not_poison_siblings(keys):
+    good = _cert(keys, MSGS[0])
+    entries = [
+        good,
+        (good[0], MSGS[1], b"\x00" * bls.G1_BYTES),  # infinity encoding
+        (good[0], MSGS[2], b"junk"),  # wrong length
+        ([], MSGS[3], good[2]),  # empty signer set
+        _cert(keys, MSGS[3]),
+    ]
+    out = bls.verify_aggregates_batch(entries)
+    assert out == [True, False, False, False, True]
+
+
+def test_distinct_signer_sets_group_separately(keys):
+    e_full = _cert(keys, MSGS[0])
+    e_sub1 = _cert(keys, MSGS[1], signers=[0, 1, 2])
+    e_sub2 = _cert(keys, MSGS[2], signers=[0, 1, 2])
+    e_bad = _cert(keys, MSGS[3], signers=[0, 1, 2], forge=1)
+    out = bls.verify_aggregates_batch([e_full, e_sub1, e_sub2, e_bad])
+    assert out == [True, True, True, False]
+    # signer-set mismatch: right aggregate, wrong claimed set
+    wrong_set = (e_sub1[0], MSGS[0], e_full[2])
+    assert bls.verify_aggregates_batch([wrong_set]) == [False]
+
+
+def test_python_fallback_agrees_with_native(keys, monkeypatch):
+    """Differential: the pure-Python RLC path must return the same
+    verdicts as the native multi-pairing on valid and mixed batches
+    (kept at k=2: python pairings are ~0.8 s each)."""
+    from simple_pbft_tpu import native
+
+    if not native.bls_available():
+        pytest.skip("no native toolchain")
+    entries = [_cert(keys, MSGS[0]), _cert(keys, MSGS[1], forge=2)]
+    native_out = bls.verify_aggregates_batch(entries)
+    monkeypatch.setattr(bls, "_native", lambda: _NoNative)
+    python_out = bls.verify_aggregates_batch(entries)
+    assert native_out == python_out == [True, False]
+
+
+def test_all_or_nothing_rejects_without_bisection(keys, monkeypatch):
+    """verify_aggregates_all on a poisoned batch must reject after ONE
+    group check — counted via the group-check hook — preserving the
+    Byzantine-certificate DoS bound of the old sequential path."""
+    calls = {"n": 0}
+    orig = bls._rlc_check
+
+    def counting(pk_set, ents):
+        calls["n"] += 1
+        return orig(pk_set, ents)
+
+    monkeypatch.setattr(bls, "_rlc_check", counting)
+    entries = [_cert(keys, m) for m in MSGS[:4]]
+    entries[1] = _cert(keys, MSGS[1], forge=0)
+    assert bls.verify_aggregates_all(entries) is False
+    assert calls["n"] == 1
+
+
+def test_halving_cost_bounded(keys, monkeypatch):
+    """One bad cert in k=8 must cost O(log k) group checks, not k."""
+    calls = {"n": 0}
+    orig = bls._rlc_check
+
+    def counting(pk_set, ents):
+        calls["n"] += 1
+        return orig(pk_set, ents)
+
+    monkeypatch.setattr(bls, "_rlc_check", counting)
+    entries = [_cert(keys, m) for m in MSGS]
+    entries[5] = _cert(keys, MSGS[5], forge=3)
+    out = bls.verify_aggregates_batch(entries)
+    assert out == [i != 5 for i in range(8)]
+    # full batch + halving path: well under one check per cert, and the
+    # single-cert bottom is verify_aggregate (not counted here)
+    assert calls["n"] <= 6

@@ -301,3 +301,64 @@ def test_bad_signature_still_rejected_through_service():
             svc.close()
 
     run(scenario())
+
+
+class SlowCpu:
+    """CPU double whose pass time scales with batch size — makes the
+    serialize-behind-a-big-pass failure observable in wall clock."""
+
+    def __init__(self, per_item_s=0.0005):
+        self.batches = []
+        self.per_item_s = per_item_s
+
+    def verify_batch(self, items):
+        self.batches.append(len(items))
+        time.sleep(len(items) * self.per_item_s)
+        return [it.sig == it.msg for it in items]
+
+
+def test_big_cpu_reroute_does_not_serialize_small_sweeps():
+    """ADVICE r5 (ISSUE 3 satellite): a big pile forced onto the CPU
+    (quarantine or depth-full) runs on its own thread, so a small
+    quorum sweep submitted while the big pass churns answers in
+    milliseconds instead of waiting out the whole pass."""
+    dev = FakeDevice()
+    svc = VerifyService(dev, cpu=SlowCpu(), cpu_cutoff=64)
+    svc._quarantined_until = time.monotonic() + 60  # device benched
+    big = svc.submit(_items(3000, tag=b"B"))  # ~1.5 s of CPU
+    for _ in range(400):  # wait until the reroute thread owns the pile
+        if svc.cpu_reroute_passes:
+            break
+        time.sleep(0.005)
+    assert svc.cpu_reroute_passes == 1
+    t0 = time.perf_counter()
+    small = svc.submit(_items(10, tag=b"s"))
+    assert small.result(10) == [True] * 10
+    small_latency = time.perf_counter() - t0
+    # the small sweep cleared while the big pass was still in flight
+    assert not big.done()
+    assert small_latency < 0.5
+    assert big.result(30) == [True] * 3000
+    svc.close()
+
+
+def test_cpu_reroute_resolves_submissions_progressively():
+    """Chunked reroute: submissions coalesced into one rerouted take
+    resolve in order as their chunk completes — the first submitter
+    never waits for the last one's items."""
+    from concurrent.futures import Future
+
+    svc = VerifyService(FakeDevice(), cpu=SlowCpu(per_item_s=0.0001))
+    svc.REROUTE_CHUNK = 64  # instance override: 4 chunks below
+    order = []
+    subs = []
+    for k in range(4):
+        fut = Future()
+        fut.add_done_callback(lambda _f, k=k: order.append(k))
+        subs.append((_items(64, tag=bytes([65 + k])), fut))
+    svc._run_cpu_chunked(subs)
+    assert order == [0, 1, 2, 3]
+    assert svc.cpu_reroute_chunks == 4
+    for _items_k, fut in subs:
+        assert fut.result(0) == [True] * 64
+    svc.close()

@@ -1,0 +1,182 @@
+"""Off-loop QC verify lane (ISSUE 3 tentpole).
+
+Unit-drives QcVerifyLane's batch-close, dedup, memo and bounded-admission
+mechanics deterministically (worker internals driven by hand), then runs
+the acceptance scenario the r5 qc256 wedge would have failed: a qc-mode
+committee fronting a real coalescing VerifyService must commit requests
+within a bounded wall clock with ZERO verify-service wedges and ZERO
+post-warmup XLA compiles, with the QC-lane counters visible in the
+unified telemetry snapshot.
+"""
+
+import asyncio
+
+import pytest
+
+from simple_pbft_tpu.committee import LocalCommittee
+from simple_pbft_tpu.consensus import qc as qc_mod
+from simple_pbft_tpu.crypto import bls
+
+
+def run(coro, timeout=300):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return [bls.keygen(bytes([i + 17]) * 32) for i in range(4)]
+
+
+class _Cfg:
+    def __init__(self, keys):
+        self.bls = {f"r{i}": pk for i, (_, pk) in enumerate(keys)}
+        self.quorum = 3
+        self.replica_ids = tuple(sorted(self.bls))
+
+    def bls_pubkey(self, nid):
+        return self.bls.get(nid)
+
+
+def _qc(cfg, keys, seq, phase="prepare", digest="d" * 64, corrupt=False):
+    shares = {
+        f"r{i}": qc_mod.sign_share(
+            sk, phase, 1000 if corrupt else 0, seq, digest
+        )
+        for i, (sk, _) in enumerate(keys[:3])
+    }
+    cert = qc_mod.build_qc(phase, 0, seq, digest, shares, cfg.quorum)
+    assert cert is not None
+    return cert
+
+
+def _drain(lane):
+    """Deterministic stand-in for one worker iteration."""
+    with lane._cond:
+        take = lane._take_locked()
+    if take:
+        lane._run_batch(take)
+
+
+def test_lane_batches_dedups_and_memoizes(keys):
+    cfg = _Cfg(keys)
+    lane = qc_mod.QcVerifyLane()
+    lane._started = True  # drive the worker by hand: deterministic
+    certs = [_qc(cfg, keys, seq=100 + i) for i in range(3)]
+    bad = _qc(cfg, keys, seq=103, corrupt=True)
+    futs = [lane.submit(cfg, c) for c in certs + [bad]]
+    dup = lane.submit(cfg, certs[0])  # concurrent duplicate: joins entry
+    assert lane.dedup_joins == 1
+    _drain(lane)
+    assert [f.result(5) for f in futs] == [True, True, True, False]
+    assert dup.result(5) is True
+    assert lane.batches == 1 and lane.batch_items == 4
+    assert lane.rlc_batches == 1 and lane.batch_fallbacks == 1
+    assert lane.verified_true == 3 and lane.verified_false == 1
+    # memo: resubmits answer inline from the process-wide cache
+    hit = lane.submit(cfg, certs[1])
+    assert hit.done() and hit.result() is True
+    miss_bad = lane.submit(cfg, bad)
+    assert miss_bad.done() and miss_bad.result() is False
+    assert lane.cache_hits == 2
+    snap = lane.snapshot()
+    assert snap["pending"] == 0 and snap["max_batch_seen"] == 4
+    assert snap["pairing_ms_ema"] > 0
+
+
+def test_lane_bounded_admission(keys):
+    cfg = _Cfg(keys)
+    lane = qc_mod.QcVerifyLane(max_pending=2)
+    lane._started = True
+    f1 = lane.submit(cfg, _qc(cfg, keys, seq=200))
+    f2 = lane.submit(cfg, _qc(cfg, keys, seq=201))
+    f3 = lane.submit(cfg, _qc(cfg, keys, seq=202))
+    with pytest.raises(qc_mod.QcLaneOverloaded):
+        f3.result(1)
+    assert lane.overload_rejections == 1
+    _drain(lane)
+    assert f1.result(5) is True and f2.result(5) is True
+
+
+def test_lane_structural_reject_inline(keys):
+    cfg = _Cfg(keys)
+    lane = qc_mod.QcVerifyLane()
+    lane._started = True
+    from simple_pbft_tpu.messages import QuorumCert
+
+    bogus = QuorumCert(
+        phase="bogus", view=0, seq=1, digest="d" * 64,
+        signers=["r0", "r1", "r2"], agg_sig="00",
+    )
+    f = lane.submit(cfg, bogus)
+    assert f.done() and f.result() is False  # no pairing spent
+    assert lane.structural_rejects == 1
+
+
+def test_verify_qcs_all_batches_and_memoizes(keys):
+    cfg = _Cfg(keys)
+    good = [_qc(cfg, keys, seq=300 + i, phase="checkpoint") for i in range(3)]
+    assert qc_mod.verify_qcs_all(cfg, good) is True
+    # memoized now: a second pass costs zero pairings (cache answers)
+    assert all(qc_mod.cached_verdict(c) is True for c in good)
+    poisoned = good + [_qc(cfg, keys, seq=304, corrupt=True)]
+    assert qc_mod.verify_qcs_all(cfg, poisoned) is False
+    # the unattributable batch failure memoized nothing for the bad cert
+    assert qc_mod.cached_verdict(poisoned[-1]) is None
+
+
+def test_qc_committee_fast_path_bounded_no_wedge(monkeypatch):
+    """The qc256-wedge regression (ISSUE 3 acceptance): a qc-mode
+    committee whose every replica fronts ONE coalescing VerifyService
+    over a real (XLA-CPU) device verifier, with the QC lane verifying
+    certificates off-loop, must commit requests within the test's
+    bounded wall clock, with zero verify-service wedges (no overload
+    rejections, no quarantine) and ZERO post-warmup compiles."""
+    from simple_pbft_tpu.crypto import tpu_verifier as tv
+    from simple_pbft_tpu.crypto.coalesce import VerifyService
+
+    # two tiny buckets keep the XLA-CPU compile bill in CI seconds while
+    # still exercising the padded-bucket shape discipline
+    monkeypatch.setattr(tv, "BUCKETS", (8, 32))
+
+    async def scenario():
+        dev = tv.TpuVerifier(initial_keys=16)
+        svc = VerifyService(dev, cpu_cutoff=0, max_batch=32)
+        com = LocalCommittee.build(
+            n=4, clients=1, qc_mode=True,
+            verifier_factory=lambda: svc,
+            view_timeout=60.0, max_batch=8,
+        )
+        com.clients[0].request_timeout = 60.0
+        # service-level warm: covers every bucket a coalesced take can
+        # hit (max_batch), closing the shape set before traffic
+        svc.warm_for_population(
+            [kp.pub for kp in com.keys.values()], max_sweep=8
+        )
+        com.start()
+        try:
+            res = await asyncio.gather(
+                *(com.clients[0].submit(f"put k{i} {i}") for i in range(6))
+            )
+            assert res == ["ok"] * 6
+        finally:
+            await com.stop()
+            svc.close()
+        snap = svc.snapshot()
+        # zero verify-service wedges
+        assert snap["overload_rejections"] == 0
+        assert snap["quarantined"] is False and snap["watchdog_failovers"] == 0
+        # shape-stable coalescing: the warmup closed the shape set
+        assert snap["device_shapes"]["warmed"] is True
+        assert snap["device_shapes"]["post_warm_compiles"] == 0
+        assert svc.device_passes > 0
+        # the QC lane actually carried the certificate checks
+        lane = qc_mod.lane_snapshot()
+        assert lane is not None
+        assert lane["submitted"] > 0 and lane["batches"] > 0
+        assert lane["pending"] == 0 and lane["overload_rejections"] == 0
+        # and its counters ride the unified telemetry snapshot
+        tel = com.node_telemetry("r0").snapshot()
+        assert tel["qc_lane"]["submitted"] >= lane["submitted"] - 1
+        assert "pairing_ms_ema" in tel["qc_lane"]
+
+    run(scenario(), timeout=240)

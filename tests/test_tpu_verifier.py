@@ -428,3 +428,35 @@ def test_row_packing_matches_oracle_and_dense():
         comb.use_row_packing(False)
     assert packed == dense == oracle
     assert pal == oracle
+
+
+def test_shape_stability_hook_post_warm(monkeypatch):
+    """Shape-stable coalescing (ISSUE 3): warm_for_population closes the
+    jit-signature set — after warmup, NO dispatch may hit a fresh shape
+    (post_warm_compiles stays 0 across every reachable batch size), and
+    a verifier warmed short of a reachable bucket is caught by the hook."""
+    from simple_pbft_tpu.crypto import tpu_verifier as tv
+
+    monkeypatch.setattr(tv, "BUCKETS", (8, 32))
+    pubs = [ref.public_key(bytes([40 + i]) * 32) for i in range(4)]
+    items = [_signed(40 + (i % 4), b"shape probe %d" % i) for i in range(40)]
+
+    v = tv.TpuVerifier(initial_keys=8)
+    v.warm_for_population(pubs, max_sweep=32)
+    snap = v.shape_snapshot()
+    assert snap["warmed"] is True and snap["post_warm_compiles"] == 0
+    base = v.shape_compiles
+    for n in (1, 5, 8, 20, 32, 40):  # 40 chunks to 32+8: no new shape
+        assert v.verify_batch(items[:n]) == [True] * n
+    assert v.shape_compiles == base
+    assert v.post_warm_compiles == 0
+    hits = v.shape_snapshot()["bucket_hits"]
+    assert set(hits) == {"8", "32"}
+
+    # under-warmed verifier: the 32 bucket was never compiled pre-warm,
+    # so the first big sweep is a mid-run compile — counted and visible
+    v2 = tv.TpuVerifier(initial_keys=8)
+    v2.warm_for_population(pubs, max_sweep=8)
+    assert v2.post_warm_compiles == 0
+    assert v2.verify_batch(items[:20]) == [True] * 20  # pads to 32
+    assert v2.post_warm_compiles == 1

@@ -447,3 +447,74 @@ def test_detached_newview_block_fetched_by_laggard():
             await c.stop()
 
     _run(main())
+
+
+def test_new_view_dedups_checkpoint_proofs_and_shrinks_wire():
+    """ISSUE 3 satellite (VERDICT weak #5: 237-419 KB NEW-VIEWs): the
+    2f+1 embedded VIEW-CHANGEs all prove the same h with the same
+    checkpoint certificate — the NEW-VIEW ships ONE pooled copy, every
+    stripped VC refills from the pool at validation, and the envelope
+    signatures still verify (the proof is detached from them)."""
+    from simple_pbft_tpu.consensus.viewchange import dedup_checkpoint_proofs
+    from simple_pbft_tpu.crypto.verifier import best_cpu_verifier
+    from simple_pbft_tpu.messages import Message
+
+    cfg, keys = make_test_committee(n=4)
+    cps = []
+    for rid in cfg.replica_ids[: cfg.quorum]:
+        cp = Checkpoint(seq=64, state_digest="d" * 64)
+        Signer(rid, keys[rid].seed).sign_msg(cp)
+        cps.append(cp.to_dict())
+    vcs = [
+        _signed_vc(cfg, keys, rid, 1, stable_seq=64, cps=cps)
+        for rid in ("r1", "r2", "r3")
+    ]
+    vc_dicts, pool = dedup_checkpoint_proofs(vcs)
+    assert len(pool) == 1 and pool[0]["seq"] == 64
+    assert all(d["checkpoint_proof"] == [] for d in vc_dicts)
+    # the originals keep their proofs (dedup works on dict copies)
+    assert all(vc.checkpoint_proof for vc in vcs)
+
+    new_primary = cfg.primary(1)
+    nv = NewView(
+        new_view=1, viewchange_proof=vc_dicts, pre_prepares=[],
+        checkpoint_pool=pool,
+    )
+    Signer(new_primary, keys[new_primary].seed).sign_msg(nv)
+    # size regression: 3 proof copies -> 1 pooled copy must cut the
+    # certificate roughly in third (the proofs dominate this NEW-VIEW)
+    inline = NewView(
+        new_view=1, viewchange_proof=[v.to_dict() for v in vcs],
+        pre_prepares=[],
+    )
+    Signer(new_primary, keys[new_primary].seed).sign_msg(inline)
+    assert len(nv.to_wire()) < 0.6 * len(inline.to_wire())
+
+    # full wire round trip -> validation refills and accepts
+    nv2 = Message.from_wire(nv.to_wire())
+    res = validate_new_view(cfg, nv2)
+    assert res is not None
+    vcs_out, items, _qcs = res
+    assert set(vcs_out) == {"r1", "r2", "r3"}
+    # refilled: each validated VC carries the full proof again
+    assert all(len(vc.checkpoint_proof) == cfg.quorum for vc in vcs_out.values())
+    # every nested signature (3 VC envelopes over DETACHED-proof
+    # payloads + 3 checkpoints per refilled proof) actually verifies
+    assert len(items) == 3 + 3 * cfg.quorum
+    assert all(best_cpu_verifier().verify_batch(items))
+
+    # a pool entry for an h nobody claims is rejected structurally
+    bad = NewView(
+        new_view=1, viewchange_proof=vc_dicts, pre_prepares=[],
+        checkpoint_pool=pool + [{"seq": 64, "proof": []}],  # dup seq
+    )
+    Signer(new_primary, keys[new_primary].seed).sign_msg(bad)
+    assert validate_new_view(cfg, Message.from_wire(bad.to_wire())) is None
+
+    # stripped VC with NO pool entry for its h must reject whole
+    naked = NewView(
+        new_view=1, viewchange_proof=vc_dicts, pre_prepares=[],
+        checkpoint_pool=[],
+    )
+    Signer(new_primary, keys[new_primary].seed).sign_msg(naked)
+    assert validate_new_view(cfg, Message.from_wire(naked.to_wire())) is None

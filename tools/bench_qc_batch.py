@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""Microbench: batched BLS QC verification vs k sequential pairing checks.
+
+The ISSUE 3 acceptance number: one random-linear-combination multi-
+pairing (crypto/bls.verify_aggregates_batch — 2 Miller loops per signer
+set) must beat k sequential verify_aggregate calls (2 Miller loops + a
+final exponentiation EACH) by >= 3x. Measures both at committee-shaped
+parameters (quorum-sized signer sets, distinct payloads per cert) and
+appends one JSON ledger line to bench_results/qc_fastpath_r06.jsonl.
+
+Usage: python tools/bench_qc_batch.py [--k 4,8,16] [--signers 9]
+       [--iters 5] [--out bench_results/qc_fastpath_r06.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simple_pbft_tpu import native  # noqa: E402
+from simple_pbft_tpu.crypto import bls  # noqa: E402
+
+
+def build_entries(n_signers: int, k: int):
+    keys = [bls.keygen(bytes([i + 1]) * 32) for i in range(n_signers)]
+    pks = [pk for _, pk in keys]
+    entries = []
+    for i in range(k):
+        msg = json.dumps(
+            {"digest": "d" * 64, "phase": "commit", "seq": i, "view": 0}
+        ).encode()
+        agg = bls.aggregate_signatures([bls.sign(sk, msg) for sk, _ in keys])
+        entries.append((pks, msg, agg))
+    return entries
+
+
+def measure(entries, iters: int):
+    k = len(entries)
+    # warm (hash_to_g1 internals, native lib load)
+    assert bls.verify_aggregates_batch(entries) == [True] * k
+    t_seq = []
+    t_bat = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = [bls.verify_aggregate(*e) for e in entries]
+        t_seq.append(time.perf_counter() - t0)
+        assert out == [True] * k
+        t0 = time.perf_counter()
+        out = bls.verify_aggregates_batch(entries)
+        t_bat.append(time.perf_counter() - t0)
+        assert out == [True] * k
+    seq_ms = min(t_seq) * 1e3
+    bat_ms = min(t_bat) * 1e3
+    return {
+        "k": k,
+        "sequential_ms": round(seq_ms, 2),
+        "batched_ms": round(bat_ms, 2),
+        "speedup": round(seq_ms / bat_ms, 2),
+        "per_cert_ms_batched": round(bat_ms / k, 3),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", default="4,8,16")
+    ap.add_argument("--signers", type=int, default=9)  # quorum at n=13
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument(
+        "--out",
+        default=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench_results", "qc_fastpath_r06.jsonl",
+        ),
+    )
+    args = ap.parse_args()
+    ks = [int(x) for x in args.k.split(",") if x.strip()]
+    cells = []
+    for k in ks:
+        entries = build_entries(args.signers, k)
+        cell = measure(entries, args.iters)
+        print(f"k={cell['k']}: seq {cell['sequential_ms']} ms, "
+              f"batched {cell['batched_ms']} ms -> {cell['speedup']}x",
+              file=sys.stderr)
+        cells.append(cell)
+    rec = {
+        "metric": "bls_qc_batch_verify_speedup",
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "native_bls": native.bls_available(),
+        "signers": args.signers,
+        "iters": args.iters,
+        "cells": cells,
+        "best_speedup": max(c["speedup"] for c in cells),
+    }
+    line = json.dumps(rec)
+    print(line)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "a") as fh:
+            fh.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -35,7 +35,8 @@ from typing import Dict, List, Optional, Tuple
 
 COLUMNS = (
     "NODE", "SRC", "VIEW", "ROLE", "EXEC", "STABLE", "BACKLOG", "VQ",
-    "SHED", "DEG", "QUAR", "REJ", "WDOG", "RTTms", "REQ/s",
+    "QCQ", "QCB", "PAIRms", "SHED", "DEG", "QUAR", "REJ", "WDOG",
+    "RTTms", "REQ/s",
 )
 
 
@@ -87,6 +88,7 @@ def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
                       dt: float) -> List[str]:
     rep = snap.get("replica") or {}
     ver = snap.get("verify") or {}
+    lane = snap.get("qc_lane") or {}  # QC verify lane (qc-mode runs only)
     met = rep.get("metrics") or {}
     committed = met.get("committed_requests", 0)
     rate = ""
@@ -107,6 +109,9 @@ def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
         str(rep.get("stable_seq", "?")),
         str(backlog),
         str(ver.get("pending_items", "")),
+        str(lane.get("pending", "")),
+        str(lane.get("batch_mean", "")),
+        (f"{lane['pairing_ms_ema']:.0f}" if "pairing_ms_ema" in lane else ""),
         str(met.get("messages_shed", 0)),
         "*" if (met.get("degraded_mode") or ver.get("degraded")) else "",
         "*" if ver.get("quarantined") else "",
@@ -124,13 +129,15 @@ def render(rows: List[List[str]]) -> str:
         "  ".join(cell.ljust(w) for cell, w in zip(r, widths)).rstrip()
         for r in table
     ]
-    execs = [int(r[4]) for r in rows if r[4].isdigit()]
+    i_exec = COLUMNS.index("EXEC")
+    i_deg, i_quar = COLUMNS.index("DEG"), COLUMNS.index("QUAR")
+    execs = [int(r[i_exec]) for r in rows if r[i_exec].isdigit()]
     if execs:
         lines.append(
             f"-- committee: {len(rows)} nodes, exec frontier "
             f"min={min(execs)} max={max(execs)} (spread {max(execs) - min(execs)}), "
-            f"degraded={sum(1 for r in rows if r[9])}, "
-            f"quarantined={sum(1 for r in rows if r[10])}"
+            f"degraded={sum(1 for r in rows if r[i_deg])}, "
+            f"quarantined={sum(1 for r in rows if r[i_quar])}"
         )
     return "\n".join(lines)
 
