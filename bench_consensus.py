@@ -162,7 +162,8 @@ async def run_config(
     verify_max_pending: int = 65536,
     status_port_base: int = 0,
     flight_dir: str = None,
-    trace_sample: int = 0,
+    trace_sample: float = 0,
+    stall_deadline: float = 30.0,
 ) -> dict:
     from simple_pbft_tpu.committee import LocalCommittee
     from simple_pbft_tpu.crypto.coalesce import VerifyService
@@ -338,19 +339,42 @@ async def run_config(
 
     # live telemetry plane (ISSUE 2): per-replica /metrics.json endpoints
     # mid-run, crash-surviving flight-recorder timelines, and sampled
-    # phase-level traces that join client and replica events
+    # phase-level traces that join client and replica events. ISSUE 4
+    # adds per-stage span attribution (spans.jsonl -> tools/
+    # critical_path.py), the event-loop lag gauge, and per-replica
+    # stall-autopsy watchdogs.
+    from simple_pbft_tpu import spans as spans_mod
+    from simple_pbft_tpu.telemetry import resolve_sample_mod
+
     status_servers = []
     recorders = []
+    watchdogs = []
     tracers = {}
-    if trace_sample > 0:
+    lag_gauge = com.attach_loop_lag()
+    # per-config span surface: configure() RESETS the process recorder,
+    # so each ladder cell's rec["spans"] describes that cell alone, and
+    # each cell gets its own <config>.spans.jsonl (critical_path
+    # discovers *.spans.jsonl) instead of an append-mode mixture
+    spans_mod.configure(
+        name,
+        os.path.join(flight_dir, f"{name}.spans.jsonl")
+        if flight_dir else None,
+    )
+    sample_mod = resolve_sample_mod(trace_sample)
+    if sample_mod > 0:
         tracers = com.attach_tracers(
-            sample_mod=trace_sample, trace_dir=flight_dir
+            sample_mod=sample_mod, trace_dir=flight_dir
         )
     if status_port_base > 0 or flight_dir:
-        from simple_pbft_tpu.telemetry import FlightRecorder, StatusServer
+        from simple_pbft_tpu.telemetry import (
+            FlightRecorder,
+            ProgressWatchdog,
+            StatusServer,
+        )
 
         for i, r in enumerate(com.replicas):
             tel = com.node_telemetry(r.id)
+            rec_f = None
             if status_port_base > 0:
                 srv = StatusServer(tel, port=status_port_base + i)
                 await srv.start()
@@ -363,6 +387,26 @@ async def run_config(
                 )
                 rec_f.start()
                 recorders.append(rec_f)
+            if flight_dir and stall_deadline > 0 and not watchdogs:
+                # wedge autopsy (ISSUE 4): a qc256-style silent stall in
+                # a BENCH run now leaves <flight-dir>/<id>.autopsy.json
+                # naming the stalled stage instead of a blank record.
+                # ONE watchdog (the first replica), not n: in-process the
+                # verify service, QC lane, task/thread stacks, and spans
+                # are all process-wide, so a committee-wide stall would
+                # trip every watchdog in the same poll interval and
+                # serialize n near-identical full stack dumps on the
+                # already-wedged loop (n=256: seconds of self-inflicted
+                # freeze). One dump describes the committee; per-process
+                # node.py deployments still get one per node.
+                wd = ProgressWatchdog(
+                    tel,
+                    path=os.path.join(flight_dir, f"{r.id}.autopsy.json"),
+                    deadline=stall_deadline,
+                    flight=rec_f,
+                )
+                wd.start()
+                watchdogs.append(wd)
         if status_servers:
             print(
                 f"telemetry: /metrics.json on 127.0.0.1:"
@@ -531,6 +575,9 @@ async def run_config(
     telemetry_end = _committee_telemetry(
         com, service if verifier == "tpu" else None
     )
+    loop_lag = lag_gauge.snapshot()
+    for wd in watchdogs:
+        await wd.stop()
     for rec_f in recorders:
         await rec_f.stop()
     for srv in status_servers:
@@ -594,8 +641,17 @@ async def run_config(
     # explains it (e.g. a low committed_req_s with end.verify.quarantined
     # true and messages_shed high IS the diagnosis, no log forensics)
     rec["telemetry"] = {"start": telemetry_start, "end": telemetry_end}
-    if trace_sample > 0:
+    # per-stage latency attribution (ISSUE 4): every cell now carries
+    # the stage histograms that say WHERE its p99 went, plus the
+    # event-loop lag gauge (a starved dispatcher core is visible) and
+    # any stall autopsies the watchdogs wrote
+    rec["spans"] = spans_mod.snapshot()["stages"]
+    rec["loop_lag"] = loop_lag
+    if watchdogs:
+        rec["autopsy_dumps"] = sum(wd.dumps for wd in watchdogs)
+    if sample_mod > 0:
         rec["trace_events"] = sum(t.events_emitted for t in tracers.values())
+        rec["trace_dropped"] = sum(t.trace_dropped for t in tracers.values())
     if schedule is not None:
         rec["faults"] = schedule.summary()
         rec["faults_applied"] = injector.applied_count
@@ -660,9 +716,19 @@ async def main() -> None:
         "run still leaves its snapshot timeline",
     )
     ap.add_argument(
-        "--trace-sample", type=int, default=0,
-        help="phase-level request tracing: keep ~1/N of requests "
-        "(deterministic hash sampling; 1 traces everything, 0 off)",
+        "--trace-sample", type=float, default=0,
+        help="phase-level request tracing: N > 1 keeps ~1/N of requests "
+        "(deterministic hash sampling); a fraction in (0, 1] keeps that "
+        "share — '--trace-sample 1.0' is the explicit full-fidelity "
+        "debug mode; 0 off. The record carries trace_dropped so "
+        "sampling loss is measurable",
+    )
+    ap.add_argument(
+        "--stall-deadline", type=float, default=30.0,
+        help="wedge autopsy (needs --flight-dir): seconds without a "
+        "commit (with work outstanding) before a replica dumps "
+        "<flight-dir>/<id>.autopsy.json naming the stalled stage "
+        "(0 disables)",
     )
     ap.add_argument(
         "--view-timeout", type=float, default=0.0,
@@ -730,6 +796,7 @@ async def main() -> None:
             status_port_base=args.status_port_base,
             flight_dir=args.flight_dir,
             trace_sample=args.trace_sample,
+            stall_deadline=args.stall_deadline,
         )
         if args.storm:
             rec = await run_config(

@@ -20,6 +20,7 @@ import time
 from collections import defaultdict
 from typing import Dict, Optional
 
+from . import spans
 from .config import CommitteeConfig
 from .crypto.signer import Signer
 from .crypto.verifier import BatchItem, Verifier, best_cpu_verifier
@@ -291,6 +292,7 @@ class Client:
         traced = rid is not None
         if traced:
             tracer.emit("submit", rid, op_bytes=len(operation))
+        t_sub = time.perf_counter()
         try:
             # first attempt: primary (+ hedged backups); afterwards:
             # broadcast (classic PBFT retransmission — backups forward to
@@ -315,6 +317,15 @@ class Client:
                         self.metrics["recovered_after_retry"] += 1
                     if traced:
                         tracer.emit("accepted", rid, attempts=attempt + 1)
+                    # submit -> f+1 accepted: the client's view of the
+                    # whole pipeline — the number every replica-side
+                    # span decomposition must add up toward. File lines
+                    # only for SAMPLED requests (volume bound).
+                    spans.record(
+                        spans.CLIENT_E2E,
+                        time.perf_counter() - t_sub,
+                        node=self.id, rid=rid, persist=traced,
+                    )
                     return result
                 except asyncio.TimeoutError:
                     if attempt == retries:

@@ -25,6 +25,7 @@ class LocalCommittee:
     net: LocalNetwork
     replicas: List[Replica] = field(default_factory=list)
     clients: List[Client] = field(default_factory=list)
+    lag_gauge: Optional[object] = None  # LoopLagGauge (attach_loop_lag)
 
     @staticmethod
     def build(
@@ -70,6 +71,9 @@ class LocalCommittee:
     async def stop(self) -> None:
         import asyncio
 
+        if self.lag_gauge is not None:
+            await self.lag_gauge.stop()
+            self.lag_gauge = None
         # concurrent: graceful stop drains each replica's pipeline (up to
         # ~10 s when certificate-heavy sweeps are mid-flight); serially a
         # 64-node teardown could take minutes. return_exceptions so one
@@ -99,15 +103,27 @@ class LocalCommittee:
             if r.id == node_id:
                 return NodeTelemetry(
                     node_id, replica=r, transport=r.transport,
-                    tracer=r.tracer,
+                    tracer=r.tracer, loop_lag=self.lag_gauge,
                 )
         for c in self.clients:
             if c.id == node_id:
                 return NodeTelemetry(
                     node_id, client=c, transport=c.transport,
-                    tracer=c.tracer,
+                    tracer=c.tracer, loop_lag=self.lag_gauge,
                 )
         raise KeyError(node_id)
+
+    def attach_loop_lag(self, interval: float = 0.1):
+        """Start the committee's event-loop lag gauge (ISSUE 4: one loop
+        runs every in-process node, so one gauge serves them all — a
+        starved dispatcher core shows in every node's snapshot). Call
+        from inside the running loop; stop via ``await
+        committee.lag_gauge.stop()`` (committee.stop() does it too)."""
+        from .telemetry import LoopLagGauge
+
+        self.lag_gauge = LoopLagGauge(interval=interval)
+        self.lag_gauge.start()
+        return self.lag_gauge
 
     def attach_tracers(self, sample_mod: int = 64, trace_dir: Optional[str] = None):
         """Give every replica AND client a RequestTracer with the same

@@ -34,6 +34,7 @@ from collections import OrderedDict
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
+from .. import spans
 from ..crypto import bls
 from ..messages import QuorumCert, qc_payload
 
@@ -252,7 +253,7 @@ class QcLaneOverloaded(RuntimeError):
 
 
 class _LaneEntry:
-    __slots__ = ("key", "pks", "payload", "agg", "futs")
+    __slots__ = ("key", "pks", "payload", "agg", "futs", "t_enq")
 
     def __init__(self, key, pks, payload, agg, fut):
         self.key = key
@@ -260,6 +261,7 @@ class _LaneEntry:
         self.payload = payload
         self.agg = agg
         self.futs = [fut]
+        self.t_enq = time.perf_counter()  # lane queue-wait span anchor
 
 
 class QcVerifyLane:
@@ -403,6 +405,11 @@ class QcVerifyLane:
 
     def _run_batch(self, take: List[_LaneEntry]) -> None:
         t0 = time.perf_counter()
+        for e in take:
+            # lane wait per certificate: submit -> batch start (includes
+            # the deliberate ~2 ms close window — that policy cost must
+            # be visible in the decomposition, not folded into "pairing")
+            spans.record(spans.QC_QUEUE, t0 - e.t_enq, n=len(e.futs))
         try:
             verdicts = bls.verify_aggregates_batch(
                 [(e.pks, e.payload, e.agg) for e in take]
@@ -418,6 +425,7 @@ class QcVerifyLane:
                     fut.set_exception(exc)
             return
         dt_ms = (time.perf_counter() - t0) * 1e3
+        spans.record(spans.QC_PAIRING, dt_ms / 1e3, n=len(take))
         self.batches += 1
         self.batch_items += len(take)
         self.max_batch_seen = max(self.max_batch_seen, len(take))

@@ -36,6 +36,7 @@ import time
 from collections import OrderedDict, defaultdict
 from typing import Any, Dict, List, Optional, Tuple
 
+from .. import spans
 from ..app import Application, KVStore
 from ..config import CommitteeConfig
 from ..crypto.coalesce import Overloaded
@@ -245,6 +246,10 @@ class Replica:
         # highest seq with an observed commit certificate (committee
         # liveness, independent of our own execution frontier)
         self.max_committed_seen = 0
+        # monotonic clock of the last locally-executed block (0 = never):
+        # the progress watchdog's stall age and pbft_top's CAGE column
+        # read this instead of re-deriving progress from counter deltas
+        self.last_commit_mono = 0.0
 
     def _auth_reply(self, reply: Reply) -> None:
         """Authenticate a reply: per-client HMAC when BOTH ends publish kx
@@ -577,6 +582,13 @@ class Replica:
             self.stats.verify_ms.record(dt * 1e3)
             self.stats.verify_items += n_fresh
             self.stats.verify_seconds += dt
+            # the replica's seat at the verify pipeline: the full round
+            # trip a sweep pays (service queue + device/CPU pass +
+            # resolution) — compare against verify.queue/verify.device
+            # to see where inside the service the wait lives
+            spans.record(
+                spans.REPLICA_VERIFY_WAIT, dt, node=self.id, n=n_fresh
+            )
 
     def _timed_verify(self, items: List[BatchItem]) -> List[bool]:
         """Worker-thread wrapper: one verifier call, instrumented so
@@ -1231,6 +1243,15 @@ class Replica:
             if self.tracer is not None:
                 # a SendCommit action means the slot just PREPARED here
                 self.tracer.slot_event("prepare", act.view, act.seq)
+            inst = self.instances.get((act.view, act.seq))
+            if inst is not None and inst.t_started and not inst.t_prepared:
+                # phase span 1/3: pre-prepare admission -> prepared
+                inst.t_prepared = time.perf_counter()
+                spans.record(
+                    spans.PHASE_PREPARE,
+                    inst.t_prepared - inst.t_started,
+                    node=self.id, view=act.view, seq=act.seq,
+                )
             await self._send_vote(Commit, "commit", act)
         elif isinstance(act, ExecuteBlock):
             if act.seq <= self.executed_seq:
@@ -1242,6 +1263,20 @@ class Replica:
             if self.tracer is not None:
                 # an ExecuteBlock action means a commit certificate formed
                 self.tracer.slot_event("commit", act.view, act.seq)
+            inst = self.instances.get((act.view, act.seq))
+            if inst is not None and not inst.t_committed:
+                # phase span 2/3: prepared -> commit certificate. Slots
+                # that skipped local preparation (QC catch-up, adopted
+                # blocks) anchor on t_started; slots with neither clock
+                # (pure hole repair) have no attributable wait to record.
+                inst.t_committed = time.perf_counter()
+                base = inst.t_prepared or inst.t_started
+                if base:
+                    spans.record(
+                        spans.PHASE_COMMIT,
+                        inst.t_committed - base,
+                        node=self.id, view=act.view, seq=act.seq,
+                    )
             self.ready[act.seq] = act
             # committee-liveness signal (failover deferral): an
             # ExecuteBlock action means a commit certificate formed for
@@ -1288,12 +1323,22 @@ class Replica:
         while (self.executed_seq + 1) in self.ready:
             act = self.ready.pop(self.executed_seq + 1)
             self.executed_seq += 1
+            self.last_commit_mono = time.monotonic()
             self.committed_log[act.seq] = act.digest
             self.metrics["committed_blocks"] += 1
             src = self.instances.get((act.view, act.seq))
+            now_pc = time.perf_counter()
             if src is not None and src.t_started:
-                self.stats.commit_ms.record(
-                    (time.perf_counter() - src.t_started) * 1e3
+                self.stats.commit_ms.record((now_pc - src.t_started) * 1e3)
+            if src is not None and src.t_committed:
+                # phase span 3/3: commit certificate -> applied in order
+                # (execution-hole wait). The three phase.* spans tile
+                # t_started -> here, so their per-slot sum reconciles
+                # with the commit_ms sample recorded above.
+                spans.record(
+                    spans.PHASE_EXECUTE,
+                    now_pc - src.t_committed,
+                    node=self.id, view=act.view, seq=act.seq,
                 )
             reqs = self._validate_block(act.block, act.digest)
             if reqs is None:  # unreachable: admission validated on entry

@@ -86,6 +86,12 @@ class Instance:
     prepare_qc: Optional[Any] = None  # verified QuorumCert(phase=prepare)
     commit_qc: Optional[Any] = None
     t_started: float = 0.0  # perf_counter at pre-prepare admission (stats)
+    # phase-transition clocks (ISSUE 4 spans): set by the runtime when
+    # the slot prepares / its commit certificate forms, so the three
+    # phase.* spans tile t_started -> execution exactly and their sum
+    # reconciles against the commit_ms histogram (tools/critical_path)
+    t_prepared: float = 0.0
+    t_committed: float = 0.0
     # incremental counts of votes matching the fixed digest — counting
     # the logs on every arrival was O(n) per vote = O(n^2) per slot per
     # replica (measured ~7% of an n=100 committee's CPU)

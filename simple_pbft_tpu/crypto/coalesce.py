@@ -39,6 +39,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import List, Optional, Sequence
 
+from .. import spans
 from .verifier import BatchItem, Verifier, best_cpu_verifier
 
 
@@ -110,7 +111,7 @@ class VerifyService:
         self._quarantine_cap = quarantine_cap
         self._quarantined_until = 0.0  # monotonic; 0 = healthy
         self._quarantine_backoff = quarantine_base
-        self._pending: deque = deque()  # (items, future)
+        self._pending: deque = deque()  # (items, future, t_enqueued)
         self._pending_items = 0
         self._cond = threading.Condition()
         self._inflight = 0
@@ -119,6 +120,11 @@ class VerifyService:
         # completion queue: (finisher, subs, t_dispatch, n_items)
         self._done_q: deque = deque()
         self._done_cond = threading.Condition()
+        # dispatch t0 of the device pass the completion thread is
+        # currently waiting on (None = idle) — with the _done_q t0s this
+        # gives snapshot() the age of the OLDEST outstanding dispatch,
+        # the number a stall autopsy blames a silent device with
+        self._finishing_t0: Optional[float] = None
         # adaptive estimates, EMA-smoothed. Seeds are deliberately mid-
         # range: a tunneled chip measures ~20-100 ms dispatch->result,
         # a co-located one ~1-5 ms; the native CPU path ~20-40k items/s
@@ -236,7 +242,9 @@ class VerifyService:
                 else:
                     if not self._started:
                         self._start_threads()
-                    self._pending.append((list(items), fut))
+                    self._pending.append(
+                        (list(items), fut, time.perf_counter())
+                    )
                     self._pending_items += len(items)
                     if self._pending_items > self.max_pending_seen:
                         self.max_pending_seen = self._pending_items
@@ -275,8 +283,21 @@ class VerifyService:
         with self._cond:
             pending = self._pending_items
             inflight = self._inflight
+        with self._done_cond:
+            t0s = [e[2] for e in self._done_q if e is not None]
+        cur = self._finishing_t0
+        if cur is not None:
+            t0s.append(cur)
+        oldest_age = (
+            round(time.perf_counter() - min(t0s), 3) if t0s else 0.0
+        )
         out = {
             "name": self.name,
+            # age of the oldest dispatched-but-unanswered device pass:
+            # reads ~RTT while healthy, grows without bound while the
+            # device is silently stalled (the r5 qc256 shape) — the
+            # field diagnose_stall() keys its verify.device verdict on
+            "inflight_oldest_age_s": oldest_age,
             "degraded": self.degraded,
             "quarantined": self.quarantined,
             "pending_items": pending,
@@ -337,23 +358,28 @@ class VerifyService:
         c = int(self._cpu_rate_ema * self._rtt_ema * 0.5)
         return max(16, min(c, 2048))
 
-    def _take_locked(self) -> "tuple[list, int]":
+    def _take_locked(self) -> "tuple[list, int, list]":
         """Pop whole submissions up to max_batch items (caller holds the
         lock). A single oversized submission is taken alone —
-        dispatch_batch chunks it internally."""
+        dispatch_batch chunks it internally. The third return is each
+        taken submission's (queue_wait_s, n_items) — the admission-queue
+        wait spans, recorded by the caller AFTER the lock drops."""
         subs = []
         total = 0
+        now = time.perf_counter()
+        waits = []
         while self._pending:
             n = len(self._pending[0][0])
             if subs and total + n > self._max_batch:
                 break
-            items, fut = self._pending.popleft()
+            items, fut, t_enq = self._pending.popleft()
             subs.append((items, fut))
+            waits.append((now - t_enq, n))
             total += n
             self._pending_items -= n
             if total >= self._max_batch:
                 break
-        return subs, total
+        return subs, total, waits
 
     def _can_dispatch_locked(self) -> bool:
         """Something pending can make progress NOW. Round-4 chip evidence
@@ -388,7 +414,7 @@ class VerifyService:
                         self._done_q.append(None)
                         self._done_cond.notify_all()
                     return
-                subs, total = self._take_locked()
+                subs, total, waits = self._take_locked()
                 if not subs:
                     continue
                 # routing is by size ALONE: piles <= cutoff clear on the
@@ -425,6 +451,12 @@ class VerifyService:
                     self._inflight += 1
             self.coalesced_submissions += len(subs)
             self.max_coalesced = max(self.max_coalesced, total)
+            for wait_s, n in waits:
+                # admission-queue wait per submission: how long a sweep's
+                # signatures sat behind earlier piles before the
+                # dispatcher even looked at them — the coalesce-wait leg
+                # of the critical path (spans.py / tools/critical_path)
+                spans.record(spans.VERIFY_QUEUE, wait_s, n=n)
             # the flattened batch is built only on the paths that consume
             # it whole — the chunked reroute works from `subs` directly,
             # so the big-pile case pays no O(total) copy in this loop
@@ -477,6 +509,10 @@ class VerifyService:
                 if entry is None:  # dispatcher's shutdown sentinel
                     return
                 finisher, subs, t0, total = entry
+            # plain attribute (GIL-atomic): snapshot() reads it to expose
+            # how long the CURRENT device pass has been in flight — the
+            # number that names a silent device in a wedge autopsy
+            self._finishing_t0 = t0
             try:
                 if self._deadline is not None:
                     verdicts = self._finish_with_deadline(
@@ -486,6 +522,7 @@ class VerifyService:
                         # watchdog fired: the pile was already failed over
                         # to the CPU and the device quarantined — only the
                         # in-flight slot remains to release
+                        self._finishing_t0 = None
                         with self._cond:
                             self._inflight -= 1
                             self._cond.notify_all()
@@ -499,6 +536,8 @@ class VerifyService:
                 self._rtt_ema = 0.8 * self._rtt_ema + 0.2 * rtt
                 self.device_passes += 1
                 self.device_pass_items += total
+                # dispatch -> result RTT of one coalesced device pass
+                spans.record(spans.VERIFY_DEVICE, rtt, n=total)
                 self._resolve(subs, verdicts)
                 # a completed pass within deadline is proof of device
                 # health: end any quarantine and reset the re-probe ladder
@@ -511,6 +550,7 @@ class VerifyService:
                     self.quarantine_recoveries += 1
                 self._quarantined_until = 0.0
                 self._quarantine_backoff = self._quarantine_base
+            self._finishing_t0 = None
             with self._cond:
                 self._inflight -= 1
                 self._cond.notify_all()
@@ -611,13 +651,18 @@ class VerifyService:
             chunk_subs.append((items, fut))
             if len(chunk) >= self.REROUTE_CHUNK:
                 self.cpu_reroute_chunks += 1
-                self._run_cpu(chunk, chunk_subs)
+                self._run_cpu(chunk, chunk_subs, stage=spans.VERIFY_REROUTE)
                 chunk, chunk_subs = [], []
         if chunk_subs:
             self.cpu_reroute_chunks += 1
-            self._run_cpu(chunk, chunk_subs)
+            self._run_cpu(chunk, chunk_subs, stage=spans.VERIFY_REROUTE)
 
-    def _run_cpu(self, batch: List[BatchItem], subs) -> None:
+    def _run_cpu(
+        self, batch: List[BatchItem], subs, stage: str = spans.VERIFY_CPU
+    ) -> None:
+        # `stage` attributes the pass in the span layer: a size-routed
+        # small pile is verify.cpu, a quarantine/depth-full reroute
+        # chunk is verify.cpu_reroute — same code, different cause
         t0 = time.perf_counter()
         try:
             verdicts = self._cpu.verify_batch(batch)
@@ -631,6 +676,7 @@ class VerifyService:
             )
         self.cpu_passes += 1
         self.cpu_pass_items += len(batch)
+        spans.record(stage, dt, n=len(batch))
         self._resolve(subs, verdicts)
 
     @staticmethod

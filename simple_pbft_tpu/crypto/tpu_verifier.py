@@ -788,6 +788,7 @@ class TpuVerifier:
         return finish
 
     def _dispatch_chunk(self, items: Sequence[BatchItem]):
+        t_prep = time.perf_counter()
         size = _bucket_size(max(len(items), self._align))
         fallback: List[int] = []
         if self._mode in ("comb", "fused"):
@@ -808,6 +809,17 @@ class TpuVerifier:
             prep = prepare_batch(items).padded(size)
             args = prep.arrays()
         self._record_shape(size)
+        # host-side prep (nibble decomposition, padding, array builds)
+        # is CPU work on the dispatcher's thread — if it rivals the
+        # device RTT the pipeline is host-bound, and only a span can say
+        # so (spans.py; the r5 "where do the other 96% go" question)
+        from .. import spans
+
+        spans.record(
+            spans.VERIFY_HOST_PREP,
+            time.perf_counter() - t_prep,
+            n=len(items),
+        )
         with _DEVICE_LOCK:
             t0 = time.perf_counter()
             dev_out = self._fn(*args)  # async: enqueue only

@@ -84,12 +84,15 @@ def make_verifier(
     raise SystemExit(f"unknown verifier backend: {name}")
 
 
-def _dump_final(node_id: str, replica, transport) -> None:
+def _dump_final(node_id: str, replica, transport, watchdog=None) -> None:
     """Shutdown dump: counters + sweep/verify/commit histograms as one
     JSON line each — the observability the perf work steers by (VERDICT
     weak #8). Called from run_node's ``finally`` so a FATAL EXCEPTION
     leaves the same post-mortem a clean SIGTERM would have (pre-ISSUE-2,
-    a crash lost everything)."""
+    a crash lost everything). With a progress watchdog attached, the
+    same path writes a FULL forensic autopsy (task/thread stacks,
+    in-flight instances, recent spans) — so SIGTERM/SIGINT leaves the
+    deep dump too, not just flight-interval snapshots (ISSUE 4)."""
     logging.info("%s: stats %s", node_id, replica.stats.dump(replica.metrics))
     logging.info(
         "%s: transport %s", node_id, dict(getattr(transport, "metrics", {}))
@@ -100,14 +103,35 @@ def _dump_final(node_id: str, replica, transport) -> None:
         # ever shedding, did the device watchdog fire, how deep did the
         # pending pile get — the post-mortem for any degraded window
         logging.info("%s: verify service %s", node_id, svc.snapshot())
+    if watchdog is not None:
+        try:
+            # a DISTINCT file: the shutdown snapshot must never overwrite
+            # a mid-run stall autopsy at the watchdog's own path — that
+            # wedged-state forensic is the artifact this subsystem exists
+            # to preserve
+            final_path = (
+                watchdog.path.replace(".autopsy.json", ".final.autopsy.json")
+                if watchdog.path else None
+            )
+            path = watchdog.dump(
+                "final dump (signal or fatal exit)", path=final_path
+            )
+            if path:
+                logging.info("%s: final autopsy at %s", node_id, path)
+        except Exception:
+            logging.exception("%s: final autopsy failed", node_id)
 
 
 async def run_node(args) -> None:
+    from . import spans
     from .telemetry import (
         FlightRecorder,
+        LoopLagGauge,
         NodeTelemetry,
+        ProgressWatchdog,
         RequestTracer,
         StatusServer,
+        resolve_sample_mod,
         write_status_file,
     )
 
@@ -130,21 +154,33 @@ async def run_node(args) -> None:
         shed_watermark=args.shed_watermark,
     )
     log_dir = getattr(args, "resolved_log_dir", None)
+    # per-stage latency attribution (ISSUE 4): spans always accumulate
+    # in-memory histograms; with a log_dir they also land as JSONL for
+    # tools/critical_path.py's cross-node decomposition
+    spans.configure(
+        args.id,
+        os.path.join(log_dir, f"{args.id}.spans.jsonl") if log_dir else None,
+    )
     tracer = None
-    if args.trace_sample > 0 and log_dir:
+    sample_mod = resolve_sample_mod(args.trace_sample)
+    if sample_mod > 0 and log_dir:
         tracer = RequestTracer(
             args.id,
-            sample_mod=args.trace_sample,
+            sample_mod=sample_mod,
             path=os.path.join(log_dir, f"{args.id}.trace.jsonl"),
         )
         replica.tracer = tracer
+    lag = LoopLagGauge()
     telemetry = NodeTelemetry(
-        args.id, replica=replica, transport=transport, tracer=tracer
+        args.id, replica=replica, transport=transport, tracer=tracer,
+        loop_lag=lag,
     )
     status = None
     recorder = None
+    watchdog = None
     try:
         replica.start()
+        lag.start()
         if args.status_port >= 0:
             # live telemetry plane: /metrics.json /healthz /trace.json
             status = StatusServer(telemetry, port=args.status_port)
@@ -164,6 +200,21 @@ async def run_node(args) -> None:
                 interval=args.flight_interval,
             )
             recorder.start()
+        if args.stall_deadline > 0:
+            # wedge autopsy (ISSUE 4): no commit for --stall-deadline
+            # seconds while client work is outstanding dumps a forensic
+            # snapshot — the r5 qc256 25-minute silence, replaced by a
+            # diagnosis file
+            watchdog = ProgressWatchdog(
+                telemetry,
+                path=(
+                    os.path.join(log_dir, f"{args.id}.autopsy.json")
+                    if log_dir else None
+                ),
+                deadline=args.stall_deadline,
+                flight=recorder,
+            )
+            watchdog.start()
         logging.info(
             "%s listening on %s (verifier=%s, n=%d, f=%d)",
             args.id, dep.addr(args.id), args.verifier, dep.cfg.n, dep.cfg.f,
@@ -182,6 +233,9 @@ async def run_node(args) -> None:
         # final frame) must not depend on an orderly exit — and no
         # telemetry teardown failure may swallow them either
         try:
+            if watchdog is not None:
+                await watchdog.stop()
+            await lag.stop()
             if recorder is not None:
                 await recorder.stop()
             if status is not None:
@@ -190,7 +244,8 @@ async def run_node(args) -> None:
                 tracer.close()
         except Exception:
             logging.exception("%s: telemetry teardown failed", args.id)
-        _dump_final(args.id, replica, transport)
+        _dump_final(args.id, replica, transport, watchdog=watchdog)
+        spans.recorder().close()
 
 
 def main() -> None:
@@ -249,11 +304,22 @@ def main() -> None:
         "timeline); 0 disables",
     )
     ap.add_argument(
-        "--trace-sample", type=int, default=128,
-        help="phase-level request tracing: keep ~1/N of requests "
+        "--trace-sample", type=float, default=128,
+        help="phase-level request tracing: N > 1 keeps ~1/N of requests "
         "(deterministic by hash of (client, timestamp), so every node "
-        "samples the SAME requests); 1 = trace everything, 0 = off; "
-        "events go to <log-dir>/<id>.trace.jsonl",
+        "samples the SAME requests); a fraction in (0, 1] keeps that "
+        "share — '--trace-sample 1.0' is the explicit full-fidelity "
+        "debug mode; 0 = off. Sampling loss is counted in the "
+        "snapshot's tracer.trace_dropped. Events go to "
+        "<log-dir>/<id>.trace.jsonl",
+    )
+    ap.add_argument(
+        "--stall-deadline", type=float, default=30.0,
+        help="wedge autopsy: seconds without a committed block (while "
+        "client work is outstanding) before a forensic dump — task/"
+        "thread stacks, verify/QC lane depths, in-flight instances, "
+        "recent spans — is written to <log-dir>/<id>.autopsy.json "
+        "(0 disables; docs/OBSERVABILITY.md)",
     )
     ap.add_argument("--log-level", default="INFO")
     ap.add_argument(

@@ -21,8 +21,23 @@ available while the run is live, three ways:
   with monotonic per-phase timestamps and view/seq/digest ids, emitted
   as JSONL that joins across nodes and client by request id.
 
-Committee-wide rendering lives in ``tools/pbft_top.py``; the schema is
-documented in ``docs/OBSERVABILITY.md``.
+ISSUE 4 adds the stall-forensics layer on the same seams:
+
+- ``LoopLagGauge``: max + EMA of event-loop scheduling delay — a
+  starved dispatcher core (the r5 qc256 suspicion) is one glance in any
+  snapshot instead of an inference from secondary symptoms;
+- ``ProgressWatchdog``: monitors commit progress; when no commit lands
+  for a configurable deadline while client work is outstanding it dumps
+  a forensic autopsy (asyncio task stacks, thread stacks, verify/QC
+  lane depths, in-flight instances, jit shape set, last N spans) so the
+  next qc256-style stall produces a diagnosis file instead of 25
+  minutes of silence. The same dump fires from node.py's final-dump
+  path on SIGTERM/SIGINT and fatal exceptions.
+
+Committee-wide rendering lives in ``tools/pbft_top.py``; per-stage
+latency attribution in ``simple_pbft_tpu/spans.py`` +
+``tools/critical_path.py``; the schema is documented in
+``docs/OBSERVABILITY.md``.
 """
 
 from __future__ import annotations
@@ -50,9 +65,16 @@ SCHEMA_VERSION = 1
 
 def replica_snapshot(replica) -> Dict[str, Any]:
     """Consensus-plane state + counters + histograms for one replica."""
+    last = getattr(replica, "last_commit_mono", 0.0)
     return {
         "id": replica.id,
         "running": bool(replica._running),
+        # seconds since this replica last applied a block (None = never):
+        # the stall gauge pbft_top's CAGE column and the progress
+        # watchdog both read
+        "last_commit_age_s": (
+            round(time.monotonic() - last, 3) if last else None
+        ),
         "view": replica.view,
         "is_primary": replica.is_primary,
         "in_view_change": bool(replica.vc.in_view_change),
@@ -117,12 +139,14 @@ class NodeTelemetry:
         transport=None,
         client=None,
         tracer: Optional["RequestTracer"] = None,
+        loop_lag: Optional["LoopLagGauge"] = None,
     ) -> None:
         self.node_id = node_id
         self.replica = replica
         self.transport = transport
         self.client = client
         self.tracer = tracer
+        self.loop_lag = loop_lag
         self._t0 = time.monotonic()
 
     def snapshot(self) -> Dict[str, Any]:
@@ -147,6 +171,25 @@ class NodeTelemetry:
             snap["transport"] = transport_snapshot(self.transport)
         if self.client is not None:
             snap["client"] = client_snapshot(self.client)
+        if self.loop_lag is not None:
+            # event-loop scheduling delay (ISSUE 4): a starved dispatcher
+            # core shows here before it shows anywhere else
+            snap["loop_lag"] = self.loop_lag.snapshot()
+        if self.tracer is not None:
+            snap["tracer"] = {
+                "sample_mod": self.tracer.sample_mod,
+                "events_emitted": self.tracer.events_emitted,
+                # sampling loss made measurable (ISSUE 4 satellite): how
+                # many sampling decisions declined to trace
+                "trace_dropped": self.tracer.trace_dropped,
+            }
+        from . import spans as spans_mod
+
+        span_snap = spans_mod.recorder()
+        if span_snap.recorded:
+            # per-stage latency attribution (spans.py): process-wide, so
+            # every in-process node reports the same decomposition
+            snap["spans"] = span_snap.snapshot()
         return snap
 
     def health(self) -> Dict[str, Any]:
@@ -263,6 +306,338 @@ class FlightRecorder:
             self._task = None
         self.record_once()  # final frame: the clean-shutdown state
         self._sink.close()
+
+
+# ---------------------------------------------------------------------------
+# event-loop lag gauge + progress watchdog with forensic autopsy (ISSUE 4)
+# ---------------------------------------------------------------------------
+
+
+class LoopLagGauge:
+    """Event-loop scheduling-delay gauge: how late does a sleep wake up.
+
+    A task sleeps ``interval`` and measures the overshoot — the time the
+    loop spent running OTHER callbacks past this task's due time. On a
+    healthy loop that is microseconds; a loop starved by a long callback
+    (a big batch prepped inline, a pairing that leaked onto the loop) or
+    a contended core (the r5 qc256 suspicion: one dispatcher core fed by
+    256 replicas) reads tens to hundreds of ms. Max + EMA land in every
+    snapshot, so starvation is a gauge, not an inference."""
+
+    def __init__(self, interval: float = 0.1):
+        self.interval = interval
+        self.max_ms = 0.0
+        self.ema_ms = 0.0
+        self.last_ms = 0.0
+        self.samples = 0
+        self._task: Optional[asyncio.Task] = None
+
+    async def _run(self) -> None:
+        while True:
+            due = time.monotonic() + self.interval
+            await asyncio.sleep(self.interval)
+            lag_ms = max(0.0, (time.monotonic() - due)) * 1e3
+            self.last_ms = lag_ms
+            self.samples += 1
+            if lag_ms > self.max_ms:
+                self.max_ms = lag_ms
+            self.ema_ms = (
+                lag_ms if self.samples == 1
+                else 0.9 * self.ema_ms + 0.1 * lag_ms
+            )
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "max_ms": round(self.max_ms, 3),
+            "ema_ms": round(self.ema_ms, 3),
+            "last_ms": round(self.last_ms, 3),
+            "samples": self.samples,
+        }
+
+
+def _format_stacks() -> Dict[str, Any]:
+    """Every asyncio task's coroutine stack + every thread's frame stack,
+    as printable strings. Pure introspection — safe to call from a
+    watchdog while the rest of the process is wedged (the wedge is
+    exactly when this runs)."""
+    import sys
+    import traceback
+
+    tasks = []
+    try:
+        for task in asyncio.all_tasks():
+            frames = task.get_stack(limit=12)
+            tasks.append({
+                "name": task.get_name(),
+                "done": task.done(),
+                "stack": [
+                    ln.rstrip()
+                    for f in frames
+                    for ln in traceback.format_stack(f, limit=1)
+                ],
+            })
+    except RuntimeError:
+        pass  # no running loop (called from a thread): threads still dump
+    threads = {}
+    import threading as _threading
+
+    names = {t.ident: t.name for t in _threading.enumerate()}
+    for ident, frame in sys._current_frames().items():
+        threads[names.get(ident, str(ident))] = [
+            ln.rstrip() for ln in traceback.format_stack(frame, limit=12)
+        ]
+    return {"tasks": tasks, "threads": threads}
+
+
+def diagnose_stall(snap: Dict[str, Any]) -> Dict[str, str]:
+    """Name the stalled stage from one snapshot — the one-line verdict a
+    wedge autopsy leads with. Ordered by causal depth: a device dispatch
+    that never returned explains a full verify queue, which explains a
+    phase that never prepared; blame the deepest symptom present."""
+    ver = snap.get("verify") or {}
+    lane = snap.get("qc_lane") or {}
+    lag = snap.get("loop_lag") or {}
+    rep = snap.get("replica") or {}
+    age = ver.get("inflight_oldest_age_s") or 0.0
+    if ver.get("inflight_passes") and age >= 1.0:
+        return {
+            "stage": "verify.device",
+            "detail": f"device dispatch in flight for {age:.1f}s "
+            f"({ver.get('pending_items', 0)} items queued behind it)",
+        }
+    if ver.get("pending_items", 0) > 0:
+        return {
+            "stage": "verify.queue",
+            "detail": f"{ver['pending_items']} items pending, "
+            f"{ver.get('inflight_passes', 0)} passes in flight "
+            f"(rtt_ms_ema {ver.get('rtt_ms_ema', 0)})",
+        }
+    if lane.get("pending", 0) > 0 or lane.get("inflight", 0) > 0:
+        return {
+            "stage": "qc.pairing",
+            "detail": f"{lane.get('pending', 0)} certs pending / "
+            f"{lane.get('inflight', 0)} in flight "
+            f"(pairing_ms_ema {lane.get('pairing_ms_ema', 0)})",
+        }
+    if lag.get("ema_ms", 0.0) > 100.0:
+        return {
+            "stage": "event_loop",
+            "detail": f"scheduling delay ema {lag['ema_ms']:.0f} ms "
+            f"(max {lag.get('max_ms', 0):.0f} ms) — loop starved",
+        }
+    if rep.get("in_view_change"):
+        return {"stage": "view_change",
+                "detail": f"frozen in view change at view {rep.get('view')}"}
+    if rep.get("ready_holes", 0) > 0:
+        return {
+            "stage": "phase.execute",
+            "detail": f"{rep['ready_holes']} committed blocks parked "
+            f"behind an execution hole at seq "
+            f"{rep.get('executed_seq', 0) + 1}",
+        }
+    if rep.get("instances", 0) > 0:
+        return {
+            "stage": "phase.prepare",
+            "detail": f"{rep['instances']} instances in flight, none "
+            "reaching quorum (votes lost or peers stalled)",
+        }
+    return {"stage": "unknown",
+            "detail": "no queued work visible in the snapshot"}
+
+
+class ProgressWatchdog:
+    """Commit-progress watchdog with automatic forensic dumps.
+
+    Watches one replica's execution frontier; when no block commits for
+    ``deadline`` seconds WHILE client work is outstanding (an idle
+    committee is not a stall), it writes one autopsy JSON file — the
+    full snapshot plus asyncio task stacks, thread stacks, the
+    in-flight instance table, and the last N spans — and appends an
+    ``{"evt": "autopsy"}`` line through the flight recorder's sink when
+    one is attached. One dump per stall: the watchdog re-arms only
+    after progress resumes, so a 25-minute wedge costs one file, not
+    1500. The r5 qc256 wedge produced zero diagnostic output; this is
+    the counterfactual."""
+
+    def __init__(
+        self,
+        telemetry: NodeTelemetry,
+        path: Optional[str] = None,
+        deadline: float = 30.0,
+        interval: float = 0.5,
+        flight: Optional[FlightRecorder] = None,
+    ) -> None:
+        self.telemetry = telemetry
+        self.path = path
+        self.deadline = deadline
+        self.interval = interval
+        self.flight = flight
+        self.dumps = 0
+        self.last_dump_path: Optional[str] = None
+        self._armed = True
+        self._t_progress = time.monotonic()
+        self._last_exec = -1
+        self._task: Optional[asyncio.Task] = None
+
+    def _work_visible(self, rep) -> bool:
+        """Is there ANY work the committee owes progress on? Beyond the
+        replica's own view (has_outstanding_work), queued crypto counts:
+        a sweep stuck in the verify service never even REACHES the
+        consensus state the replica's check reads — exactly the r5
+        device-stall shape, where the primary looked idle because the
+        request was wedged one layer below it."""
+        try:
+            if rep.has_outstanding_work():
+                return True
+        except Exception:
+            return True  # introspection failing IS suspicious
+        svc = rep.verifier
+        if getattr(svc, "_pending_items", 0) or getattr(svc, "_inflight", 0):
+            return True
+        lane = qc_lane_snapshot()
+        if lane is not None and (lane["pending"] or lane["inflight"]):
+            return True
+        return False
+
+    def _check(self) -> None:
+        rep = self.telemetry.replica
+        if rep is None:
+            return
+        now = time.monotonic()
+        exec_seq = rep.executed_seq
+        if exec_seq != self._last_exec:
+            self._last_exec = exec_seq
+            self._t_progress = now
+            self._armed = True  # progress resumed: next stall dumps again
+            return
+        if not self._work_visible(rep):
+            # idle is not a stall: the clock starts when work arrives.
+            # Re-arm too — a stall that CLEARED without a commit (shed
+            # queue, clients gave up) must not leave the watchdog dead
+            # for the next, distinct wedge (progress alone re-arms only
+            # when something actually commits)
+            self._t_progress = now
+            self._armed = True
+            return
+        stalled_for = now - self._t_progress
+        if self._armed and stalled_for >= self.deadline:
+            self._armed = False
+            self.dump(
+                f"no commit for {stalled_for:.1f}s with outstanding work "
+                f"(deadline {self.deadline:.1f}s)"
+            )
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                self._check()
+            except Exception:  # the watchdog must outlive snapshot bugs
+                log.exception("progress watchdog check failed")
+            await asyncio.sleep(self.interval)
+
+    def start(self) -> None:
+        self._t_progress = time.monotonic()
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def _instance_table(self, limit: int = 64) -> List[Dict[str, Any]]:
+        """The oldest in-flight (view, seq) instances with their stage —
+        which slot is stuck, and at what phase."""
+        rep = self.telemetry.replica
+        if rep is None:
+            return []
+        now = time.perf_counter()
+        rows = []
+        for (view, seq), inst in sorted(rep.instances.items())[:limit]:
+            if inst.executed:
+                continue
+            rows.append({
+                "view": view,
+                "seq": seq,
+                "stage": inst.stage.name,
+                "age_s": (
+                    round(now - inst.t_started, 3) if inst.t_started else None
+                ),
+                "prepares": len(inst.prepares),
+                "commits": len(inst.commits),
+                "has_block": inst.block is not None,
+                "prepare_qc": inst.prepare_qc is not None,
+                "commit_qc": inst.commit_qc is not None,
+            })
+        return rows
+
+    def dump(self, reason: str, path: Optional[str] = None) -> Optional[str]:
+        """Write the autopsy NOW. ``path`` overrides the configured
+        stall-autopsy file — the SIGTERM/final-dump entry (node.py)
+        passes a distinct one, because "latest wins" at the stall path
+        would let a healthy shutdown snapshot OVERWRITE the wedged-state
+        forensics the stall dump captured earlier in the run. Returns
+        the file path, or None when only in-memory/log surfaces were
+        available."""
+        from . import spans as spans_mod
+
+        try:
+            snap = self.telemetry.snapshot()
+        except Exception:
+            log.exception("autopsy snapshot failed; dumping stacks only")
+            snap = {"error": "snapshot failed"}
+        doc = {
+            "evt": "autopsy",
+            "schema": SCHEMA_VERSION,
+            "node": self.telemetry.node_id,
+            "reason": reason,
+            "t_wall": round(time.time(), 3),
+            "t_mono": round(time.monotonic(), 3),
+            "suspect": diagnose_stall(snap),
+            "snapshot": snap,
+            "instances_inflight": self._instance_table(),
+            "spans_recent": spans_mod.recent(256),
+            **_format_stacks(),
+        }
+        self.dumps += 1
+        log.error(
+            "AUTOPSY %s: %s — suspect %s (%s)",
+            self.telemetry.node_id, reason,
+            doc["suspect"]["stage"], doc["suspect"]["detail"],
+        )
+        if self.flight is not None:
+            # the autopsy joins the flight timeline too (one JSONL line),
+            # so post-mortem tooling sees WHEN in the timeline it fired
+            self.flight._sink.write(doc)
+        out_path = path if path is not None else self.path
+        if out_path is None:
+            return None
+        try:
+            os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+            tmp = f"{out_path}.tmp"
+            with open(tmp, "w") as fh:
+                json.dump(doc, fh, sort_keys=True, indent=1)
+            os.replace(tmp, out_path)  # latest stall autopsy wins, atomically
+        except OSError:
+            log.exception("autopsy write failed (in-memory surfaces remain)")
+            return None
+        self.last_dump_path = out_path
+        return out_path
 
 
 # ---------------------------------------------------------------------------
@@ -400,6 +775,21 @@ def trace_sampled(client_id: str, timestamp: int, sample_mod: int) -> bool:
     return int.from_bytes(h[:8], "big") % sample_mod == 0
 
 
+def resolve_sample_mod(value: float) -> int:
+    """Map a ``--trace-sample`` argument to a sampling modulus.
+
+    Two spellings, one flag (ISSUE 4 satellite): a value in (0, 1] is a
+    FRACTION — ``--trace-sample 1.0`` is the explicit full-fidelity
+    debug mode, 0.25 keeps ~a quarter; a value > 1 is the historical
+    modulus — 128 keeps ~1/128. 0 (or negative) disables tracing."""
+    v = float(value)
+    if v <= 0:
+        return 0
+    if v <= 1.0:
+        return max(1, round(1.0 / v))
+    return int(round(v))
+
+
 class RequestTracer:
     """Per-node emitter for sampled request lifecycle events.
 
@@ -429,6 +819,13 @@ class RequestTracer:
         self._slots: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._sink = _JsonlSink(path) if path else None
         self.events_emitted = 0
+        # sampling loss, counted where it happens: every sampling
+        # decision that declined to trace. A run asserting "why is this
+        # request missing from the trace" reads this instead of guessing
+        # whether the tracer dropped it or never saw it (ISSUE 4
+        # satellite; 0 under --trace-sample 1.0 is the full-fidelity
+        # proof).
+        self.trace_dropped = 0
 
     def rid_if_sampled(self, client_id: str, timestamp: int) -> Optional[str]:
         """The request id when sampled, else None — the one-call shape
@@ -436,6 +833,7 @@ class RequestTracer:
         ``trace_sampled``)."""
         if trace_sampled(client_id, timestamp, self.sample_mod):
             return request_id(client_id, timestamp)
+        self.trace_dropped += 1
         return None
 
     def emit(self, phase: str, rid: str, **fields) -> None:

@@ -11,8 +11,11 @@ from __future__ import annotations
 
 import asyncio
 import random
+import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, Optional, Set, Tuple
+
+from .. import spans
 
 
 @dataclass
@@ -62,12 +65,16 @@ class LocalNetwork:
             return
         copies = 2 if f.rng.random() < f.duplicate_rate else 1
         lo, hi = f.delay_range
+        # messages are stamped at SEND: the receiver's recv span is the
+        # full transport residency (injected fault delay + queue wait +
+        # receiver scheduling) — the wire's leg of the critical path
+        item = (time.perf_counter(), raw)
         for _ in range(copies):
             delay = f.rng.uniform(lo, hi) if hi > 0 else 0.0
             if delay > 0:
-                asyncio.get_running_loop().call_later(delay, q.put_nowait, raw)
+                asyncio.get_running_loop().call_later(delay, q.put_nowait, item)
             else:
-                q.put_nowait(raw)
+                q.put_nowait(item)
             self.delivered += 1
 
 
@@ -94,14 +101,28 @@ class LocalEndpoint:
                 await self.net._deliver(self.node_id, dest, raw)
 
     async def recv(self) -> bytes:
-        raw = await self.queue.get()
+        t_sent, raw = await self.queue.get()
         self.metrics["recv"] += 1
+        # histogram/ring only (persist=False): one span per message is
+        # fine in memory, but must never become a JSONL line per message
+        spans.record(
+            spans.TRANSPORT_QUEUE,
+            time.perf_counter() - t_sent,
+            node=self.node_id,
+            persist=False,
+        )
         return raw
 
     def recv_nowait(self) -> Optional[bytes]:
         try:
-            raw = self.queue.get_nowait()
+            t_sent, raw = self.queue.get_nowait()
         except asyncio.QueueEmpty:
             return None
         self.metrics["recv"] += 1
+        spans.record(
+            spans.TRANSPORT_QUEUE,
+            time.perf_counter() - t_sent,
+            node=self.node_id,
+            persist=False,
+        )
         return raw

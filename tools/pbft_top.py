@@ -34,9 +34,9 @@ import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 COLUMNS = (
-    "NODE", "SRC", "VIEW", "ROLE", "EXEC", "STABLE", "BACKLOG", "VQ",
-    "QCQ", "QCB", "PAIRms", "SHED", "DEG", "QUAR", "REJ", "WDOG",
-    "RTTms", "REQ/s",
+    "NODE", "SRC", "VIEW", "ROLE", "EXEC", "STABLE", "CAGE", "BACKLOG",
+    "VQ", "QCQ", "QCB", "PAIRms", "SHED", "DEG", "QUAR", "REJ", "WDOG",
+    "RTTms", "LAGms", "REQ/s",
 )
 
 
@@ -89,7 +89,11 @@ def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
     rep = snap.get("replica") or {}
     ver = snap.get("verify") or {}
     lane = snap.get("qc_lane") or {}  # QC verify lane (qc-mode runs only)
+    lag = snap.get("loop_lag") or {}  # event-loop scheduling delay
     met = rep.get("metrics") or {}
+    # commit age: seconds since this node last applied a block — the
+    # wedge gauge (a live view with CAGE climbing IS the qc256 shape)
+    cage = rep.get("last_commit_age_s")
     committed = met.get("committed_requests", 0)
     rate = ""
     if prev is not None and dt > 0:
@@ -107,6 +111,7 @@ def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
          else "vc" if rep.get("in_view_change") else "bkup"),
         str(rep.get("executed_seq", "?")),
         str(rep.get("stable_seq", "?")),
+        (f"{cage:.1f}" if isinstance(cage, (int, float)) else ""),
         str(backlog),
         str(ver.get("pending_items", "")),
         str(lane.get("pending", "")),
@@ -118,6 +123,7 @@ def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
         str(ver.get("overload_rejections", "")),
         str(ver.get("watchdog_failovers", "")),
         (f"{ver['rtt_ms_ema']:.0f}" if "rtt_ms_ema" in ver else ""),
+        (f"{lag['ema_ms']:.1f}" if "ema_ms" in lag else ""),
         rate,
     ]
 
