@@ -365,6 +365,14 @@ async def run_config(
         tracers = com.attach_tracers(
             sample_mod=sample_mod, trace_dir=flight_dir
         )
+    # consensus audit plane (ISSUE 5): with a flight dir every replica
+    # gets a SafetyAuditor — evidence + observation ledgers land next to
+    # the flight timelines, so tools/ledger_audit.py can join the whole
+    # committee's run post-hoc (and a --fault-schedule equiv=/forkckpt=
+    # run proves detection end to end)
+    auditors = {}
+    if flight_dir:
+        auditors = com.attach_auditors(log_dir=flight_dir)
     if status_port_base > 0 or flight_dir:
         from simple_pbft_tpu.telemetry import (
             FlightRecorder,
@@ -407,6 +415,10 @@ async def run_config(
                 )
                 wd.start()
                 watchdogs.append(wd)
+                for aud in auditors.values():
+                    # a safety violation fires the same forensic dump
+                    # path as a stall (one autopsy per auditor)
+                    aud.attach_watchdog(wd)
         if status_servers:
             print(
                 f"telemetry: /metrics.json on 127.0.0.1:"
@@ -586,6 +598,8 @@ async def run_config(
     await com.stop()
     for tr in tracers.values():
         tr.close()
+    for aud in auditors.values():
+        aud.close()
     if verifier == "tpu":
         service.close()
 
@@ -652,11 +666,31 @@ async def run_config(
     if sample_mod > 0:
         rec["trace_events"] = sum(t.events_emitted for t in tracers.values())
         rec["trace_dropped"] = sum(t.trace_dropped for t in tracers.values())
+    if auditors:
+        # accountability summary: any safety violation during the run,
+        # broken down by invariant, with the union of accused replicas —
+        # zero across the board is the honest-run clean bill
+        by_kind = {}
+        accused = set()
+        for aud in auditors.values():
+            for k, v in aud.by_kind.items():
+                by_kind[k] = by_kind.get(k, 0) + v
+            accused.update(aud.accused_ever)
+        rec["audit"] = {
+            "violations": sum(a.violations for a in auditors.values()),
+            "observations": sum(a.observations for a in auditors.values()),
+            "by_kind": dict(sorted(by_kind.items())),
+            "accused": sorted(accused),
+        }
     if schedule is not None:
         rec["faults"] = schedule.summary()
         rec["faults_applied"] = injector.applied_count
         rec["faults_skipped"] = injector.skipped
         rec["fault_crashes"] = injector.crashes_applied
+        # byzantine wrappers (equivocate / fork_checkpoint events): how
+        # many frames were actually forged — a detection test asserting
+        # "the auditor accused rX" must also prove rX really misbehaved
+        rec["fault_byzantine_injections"] = injector.byzantine_injections
     return rec
 
 
@@ -690,7 +724,10 @@ async def main() -> None:
         help="deterministic seeded fault schedule (simple_pbft_tpu/"
         "faults.py), e.g. seed=42,crashes=3,drops=1,delays=1,stalls=1 — "
         "the reproducible chaos/storm cell; crash counts here give the "
-        "crash-count-matched storm A/B (stalls need --verifier tpu)",
+        "crash-count-matched storm A/B (stalls need --verifier tpu). "
+        "Byzantine injectors: equiv=N arms equivocating primaries, "
+        "forkckpt=N checkpoint forkers — pair with --flight-dir so the "
+        "audit plane's ledgers prove detection (docs/AUDIT.md)",
     )
     ap.add_argument(
         "--verify-deadline", type=float, default=60.0,

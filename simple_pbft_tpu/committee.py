@@ -125,6 +125,24 @@ class LocalCommittee:
         self.lag_gauge.start()
         return self.lag_gauge
 
+    def attach_auditors(self, log_dir: Optional[str] = None,
+                        watchdog=None) -> Dict[str, object]:
+        """Give every replica a SafetyAuditor (the ISSUE 5 audit plane):
+        online safety-invariant checks over the verified message stream,
+        with evidence + observation ledgers under ``log_dir`` (None =
+        in-memory surfaces only). ``watchdog`` (a ProgressWatchdog)
+        makes a safety violation trigger the same forensic dump path as
+        a stall. Returns {replica_id: auditor}; close each auditor after
+        ``stop()`` to flush the ledgers."""
+        from .audit import SafetyAuditor
+
+        auditors: Dict[str, object] = {}
+        for r in self.replicas:
+            auditors[r.id] = r.auditor = SafetyAuditor(
+                r.id, self.cfg, log_dir=log_dir, watchdog=watchdog
+            )
+        return auditors
+
     def attach_tracers(self, sample_mod: int = 64, trace_dir: Optional[str] = None):
         """Give every replica AND client a RequestTracer with the same
         deterministic sampling, so a sampled request's lifecycle exists

@@ -174,6 +174,11 @@ class Replica:
         # hooks are no-ops while None, so steady-state cost is one
         # attribute check per event
         self.tracer = None
+        # online safety-invariant monitor (audit.SafetyAuditor, ISSUE 5):
+        # attached like the tracer; observes the signature-VERIFIED
+        # message stream plus local commit/checkpoint events and appends
+        # tamper-evident evidence records on equivocation/fork/divergence
+        self.auditor = None
         self._replica_set = frozenset(cfg.replica_ids)
         self._running = False
         self._task: Optional[asyncio.Task] = None
@@ -658,6 +663,11 @@ class Replica:
                 else:
                     self.metrics["bad_sig"] += 1
         for msg in accepted:
+            if self.auditor is not None:
+                # the audit tap: every message past signature verification
+                # (QuorumCerts are audited post-pairing in _on_qc instead —
+                # an unverified aggregate must never become evidence)
+                self.auditor.observe_message(msg)
             await self._route(msg)
         await self._propose_if_ready()
         self.stats.sweep_ms.record((time.perf_counter() - t0) * 1e3)
@@ -774,6 +784,11 @@ class Replica:
             res = validate_new_view(self.cfg, msg)
             if res is None:
                 self.metrics["bad_newview_precheck"] += 1
+                if self.auditor is not None:
+                    # an invalid certificate under the primary's envelope
+                    # signature is evidence; the auditor re-verifies the
+                    # (not-yet-batch-checked) envelope before recording
+                    self.auditor.observe_rejected_new_view(msg)
                 return []
             msg._validated = res
             items.extend(res[1])
@@ -1008,6 +1023,10 @@ class Replica:
         self.signer.sign_msg(pp)
         self.metrics["proposed_blocks"] += 1
         self.metrics["proposed_requests"] += len(block)
+        if self.auditor is not None:
+            # our own proposal never transits _finish_sweep: log it so the
+            # cross-node ledger holds the primary's own signed record too
+            self.auditor.observe_message(pp)
         await self.transport.broadcast(pp.to_wire(), self.cfg.replica_ids)
         await self._on_phase(pp)  # self-delivery
 
@@ -1227,6 +1246,10 @@ class Replica:
                 self._qc_bad_by_sender.get(bad_key, 0) + 1
             )
             return
+        if self.auditor is not None:
+            # pairing-verified: safe to audit (conflicting aggregates at
+            # one (view, seq, phase) convict their overlapping signers)
+            self.auditor.observe_qc(msg)
         inst = self._instance(msg.view, msg.seq)
         actions = (
             inst.on_prepare_qc(msg)
@@ -1326,6 +1349,10 @@ class Replica:
             self.last_commit_mono = time.monotonic()
             self.committed_log[act.seq] = act.digest
             self.metrics["committed_blocks"] += 1
+            if self.auditor is not None:
+                # commit-uniqueness check + the per-seq digest line the
+                # cross-node agreement matrix joins (audit I3)
+                self.auditor.observe_commit(act.view, act.seq, act.digest)
             src = self.instances.get((act.view, act.seq))
             now_pc = time.perf_counter()
             if src is not None and src.t_started:
@@ -1565,6 +1592,11 @@ class Replica:
                 self.bls_sk, "checkpoint", 0, seq, digest
             )
         self.signer.sign_msg(cp)
+        if self.auditor is not None:
+            # own checkpoint: the ledger line cross-node state-digest
+            # agreement is computed from, and the local reference peers'
+            # checkpoints are compared against (audit I2)
+            self.auditor.observe_message(cp)
         await self._on_checkpoint(cp)  # count our own
         await self.transport.broadcast(cp.to_wire(), self.cfg.replica_ids)
 
@@ -2133,6 +2165,9 @@ class Replica:
             return
         self.stable_seq = seq
         self.metrics["stable_checkpoint"] = seq
+        if self.auditor is not None:
+            # audit stores fold with the same watermark as everything else
+            self.auditor.gc(seq)
         # GC below the watermark: instances, checkpoint votes, committed
         # log, snapshots, and per-request dedup state. This is the log GC
         # the reference never had (CommittedMsgs grows forever, node.go:246).

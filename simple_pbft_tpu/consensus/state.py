@@ -92,11 +92,28 @@ class Instance:
     # reconciles against the commit_ms histogram (tools/critical_path)
     t_prepared: float = 0.0
     t_committed: float = 0.0
+    # conflicting-digest rejections retained for forensics (ISSUE 5):
+    # (sender, digest) of messages this slot turned away because they
+    # disagreed with the fixed digest — the wedge-autopsy instance table
+    # can then tell "slot starved by loss" from "slot contested by a
+    # fork" at a glance. Compact tuples, not the messages: a byzantine
+    # pre-prepare carries an attacker-sized block, and pinning four of
+    # those per contested in-flight slot until watermark GC would be a
+    # memory lever. The audit plane (audit.SafetyAuditor) independently
+    # records the full signed evidence; this is only the state
+    # machine's own breadcrumb.
+    conflicts: List[Any] = field(default_factory=list)
     # incremental counts of votes matching the fixed digest — counting
     # the logs on every arrival was O(n) per vote = O(n^2) per slot per
     # replica (measured ~7% of an n=100 committee's CPU)
     _prep_matching: int = 0
     _com_matching: int = 0
+
+    MAX_CONFLICTS = 4  # forensic breadcrumbs, not a log
+
+    def _note_conflict(self, msg) -> None:
+        if len(self.conflicts) < self.MAX_CONFLICTS:
+            self.conflicts.append((msg.sender, msg.digest))
 
     def _recount_matching(self) -> None:
         """Digest just became fixed: count the buffered early votes."""
@@ -121,12 +138,15 @@ class Instance:
             return []  # only the view's primary may propose (verifyMsg's
             # primary-identity check; a Byzantine backup must not steal slots)
         if self.pre_prepare is not None:
+            if msg.digest != self.digest:
+                self._note_conflict(msg)  # contested slot: keep the proof
             return []  # already have one for this slot (first wins)
         if self.digest is not None and msg.digest != self.digest:
             # the slot's digest was already fixed by a verified quorum
             # certificate (QC mode, QC-before-pre-prepare arrival order);
             # an equivocating primary must not swap in a different block
             # and ride the stored commit QC into executing it
+            self._note_conflict(msg)
             return []
         if PrePrepare.block_digest(msg.block) != msg.digest:
             return []  # digest mismatch — mirrors verifyMsg digest check
@@ -148,6 +168,7 @@ class Instance:
         if msg.view != self.view or msg.seq != self.seq:
             return []
         if self.digest is not None and msg.digest != self.digest:
+            self._note_conflict(msg)
             return []  # vote for a different proposal
         if msg.sender in self.prepares:
             return []  # duplicate sender
@@ -161,6 +182,7 @@ class Instance:
         if msg.view != self.view or msg.seq != self.seq:
             return []
         if self.digest is not None and msg.digest != self.digest:
+            self._note_conflict(msg)
             return []
         if msg.sender in self.commits:
             return []

@@ -827,6 +827,12 @@ class ViewChanger:
         if res is not None:
             if not await self._verify_qcs(res[3]):
                 r.metrics["bad_viewchange_qc"] += 1
+                if r.auditor is not None:
+                    # the envelope was signature-verified; a certificate
+                    # carrying unpairable aggregates is audit evidence
+                    r.auditor.observe_bad_certificate_qc(
+                        msg, "viewchange_bad_qc"
+                    )
                 return
         store = self.vc_store.setdefault(msg.new_view, {})
         # Backups keep only the SENDER (join counting) — retaining the
@@ -963,9 +969,18 @@ class ViewChanger:
             res = validate_new_view(r.cfg, msg)
         if res is None:
             r.metrics["bad_newview"] += 1
+            if r.auditor is not None:
+                # arrived through the verified sweep, so the envelope is
+                # good: an invalid NEW-VIEW under the primary's signature
+                # is proof-grade evidence (audit I4)
+                r.auditor.observe_rejected_new_view(
+                    msg, envelope_verified=True
+                )
             return
         if not await self._verify_qcs(res[2]):
             r.metrics["bad_newview_qc"] += 1
+            if r.auditor is not None:
+                r.auditor.observe_bad_certificate_qc(msg, "newview_bad_qc")
             return
         vcs, _, nvqcs = res
         h, o_set = compute_o_set(r.cfg, vcs, msg.new_view)

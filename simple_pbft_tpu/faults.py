@@ -23,6 +23,16 @@ Fault kinds:
                      block for ``duration`` seconds (or until released).
                      This is the fault the VerifyService dispatch-
                      deadline watchdog exists for — see crypto/coalesce.
+- ``equivocate``   — wrap the target's transport in EquivocatingPrimary:
+                     its pre-prepares FORK — half the committee gets the
+                     real block, the other half a validly-signed variant
+                     with a different digest (disjoint recipient halves,
+                     so no single honest node sees both). The detection
+                     target of the audit plane (docs/AUDIT.md).
+- ``fork_checkpoint`` — wrap the target in ForkingCheckpointer: its
+                     outbound checkpoints carry a wrong state digest,
+                     validly re-signed — the checkpoint-divergence
+                     detection target.
 
 The injector drives a LocalCommittee (transport/local.py); the wrappers
 slot into any verifier seam. Real-process deployments get the same
@@ -38,8 +48,12 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from .crypto.signer import Signer
+from .messages import Checkpoint, Message, PrePrepare, sha256_hex
+
 KINDS = (
     "crash", "drop_window", "delay_window", "slow_verifier", "stall_device",
+    "equivocate", "fork_checkpoint",
 )
 
 
@@ -81,6 +95,8 @@ class FaultSchedule:
         delay_windows: int = 0,
         slow_verifier_windows: int = 0,
         device_stalls: int = 0,
+        equivocators: int = 0,
+        checkpoint_forkers: int = 0,
         replica_ids: Sequence[str] = (),
         drop_rate: float = 0.02,
         delay_s: float = 0.03,
@@ -130,6 +146,19 @@ class FaultSchedule:
             events.append(FaultEvent(
                 t=t, kind="stall_device", duration=stall_s,
             ))
+        for t in times(equivocators):
+            # "" = whoever is primary at fire time: equivocation is a
+            # PRIMARY behavior (pre-prepare forks), so the live primary
+            # is the only target that exercises the detection path
+            events.append(FaultEvent(t=t, kind="equivocate"))
+        for t in times(checkpoint_forkers):
+            # any replica can fork its checkpoints; pick one
+            # deterministically when the committee roster is known
+            target = (
+                rng.choice(list(replica_ids)) if replica_ids else ""
+            )
+            events.append(FaultEvent(t=t, kind="fork_checkpoint",
+                                     target=target))
         events.sort(key=lambda e: (e.t, e.kind, e.target))
         return cls(seed=seed, horizon=horizon, events=tuple(events))
 
@@ -137,12 +166,14 @@ class FaultSchedule:
     def parse(cls, spec: str, horizon: float,
               replica_ids: Sequence[str] = ()) -> "FaultSchedule":
         """Build from a CLI spec like
-        ``seed=42,crashes=3,drops=1,delays=1,slow=0,stalls=1`` —
-        the bench_consensus --fault-schedule format. Raises ValueError
-        on unknown keys (a typo must not silently mean 'no faults')."""
+        ``seed=42,crashes=3,drops=1,delays=1,slow=0,stalls=1,equiv=1,
+        forkckpt=1`` — the bench_consensus --fault-schedule format.
+        Raises ValueError on unknown keys (a typo must not silently
+        mean 'no faults')."""
         raw = dict(kv.split("=", 1) for kv in spec.split(",") if kv)
         known = {"seed", "crashes", "drops", "delays", "slow", "stalls",
-                 "stall_s", "drop_rate", "delay_s", "slow_s"}
+                 "stall_s", "drop_rate", "delay_s", "slow_s",
+                 "equiv", "forkckpt"}
         bad = set(raw) - known
         if bad:
             raise ValueError(f"unknown fault-schedule keys {sorted(bad)}")
@@ -154,6 +185,8 @@ class FaultSchedule:
             delay_windows=int(raw.get("delays", 0)),
             slow_verifier_windows=int(raw.get("slow", 0)),
             device_stalls=int(raw.get("stalls", 0)),
+            equivocators=int(raw.get("equiv", 0)),
+            checkpoint_forkers=int(raw.get("forkckpt", 0)),
             replica_ids=replica_ids,
             drop_rate=float(raw.get("drop_rate", 0.02)),
             delay_s=float(raw.get("delay_s", 0.03)),
@@ -287,6 +320,109 @@ class StallableDevice:
 
 
 # ---------------------------------------------------------------------------
+# byzantine transport wrappers (ISSUE 5: detection targets for the audit
+# plane — valid signatures, lying content)
+# ---------------------------------------------------------------------------
+
+
+class ByzantineTransport:
+    """Passthrough transport base for byzantine wrappers: subclasses
+    override ``_mutate`` (per-frame rewrite) and/or ``broadcast``.
+    ``injections`` counts frames actually forged, so a bench record can
+    state how much byzantine traffic a run really carried."""
+
+    def __init__(self, inner, signer: Signer) -> None:
+        self._inner = inner
+        self.signer = signer
+        self.node_id = inner.node_id
+        self.injections = 0
+
+    def _mutate(self, raw: bytes) -> bytes:
+        return raw
+
+    async def send(self, dest, raw):
+        await self._inner.send(dest, self._mutate(raw))
+
+    async def broadcast(self, raw, dests):
+        await self._inner.broadcast(self._mutate(raw), dests)
+
+    async def recv(self):
+        return await self._inner.recv()
+
+    def recv_nowait(self):
+        return self._inner.recv_nowait()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class EquivocatingPrimary(ByzantineTransport):
+    """Deterministic equivocator: every pre-prepare with a block is
+    FORKED — the real block to one half of the committee, a
+    validly-signed variant (reversed-and-truncated: the strongest fork
+    admissible without forging CLIENT signatures) with a different
+    digest to the other half. Disjoint recipient halves by construction,
+    so no single honest node receives both messages — the case only the
+    cross-node ledger join (tools/ledger_audit.py) or a later repair
+    round trip can expose."""
+
+    def _fork(self, pp: PrePrepare) -> bytes:
+        block = list(reversed(pp.block))[: max(1, len(pp.block) - 1)]
+        if block == pp.block:
+            block = []  # single-request block: fork to the no-op block
+        forked = PrePrepare(
+            view=pp.view, seq=pp.seq,
+            digest=PrePrepare.block_digest(block), block=block,
+        )
+        self.signer.sign_msg(forked)
+        return forked.to_wire()
+
+    async def broadcast(self, raw, dests):
+        try:
+            msg = Message.from_wire(raw)
+        except ValueError:
+            msg = None
+        if isinstance(msg, PrePrepare) and msg.block:
+            forked_raw = self._fork(msg)
+            self.injections += 1
+            others = [d for d in dests if d != self.node_id]
+            for i, dest in enumerate(others):
+                await self._inner.send(
+                    dest, raw if i % 2 == 0 else forked_raw
+                )
+            return
+        await self._inner.broadcast(raw, dests)
+
+
+class ForkingCheckpointer(ByzantineTransport):
+    """Deterministic checkpoint forker: every OUTBOUND own checkpoint's
+    state digest is replaced (derived from the real one, so it is
+    deterministic and stable across resends) and validly re-signed. The
+    replica's local state stays honest — only the wire lies, which is
+    exactly the shape the checkpoint-divergence invariant (audit I2)
+    must catch: peers see a signed digest that disagrees with their
+    own deterministic fold."""
+
+    def _mutate(self, raw: bytes) -> bytes:
+        try:
+            msg = Message.from_wire(raw)
+        except ValueError:
+            return raw
+        if isinstance(msg, Checkpoint) and msg.sender == self.node_id:
+            msg.state_digest = sha256_hex(
+                (msg.state_digest + ":forked").encode()
+            )
+            # the BLS share signed the HONEST digest; shipping it would
+            # just poison aggregates — blank it (shape-invalid, so QC
+            # checkpoint aggregation skips this vote cleanly)
+            msg.bls_share = ""
+            self.signer.sign_msg(msg)
+            self.injections += 1
+            return msg.to_wire()
+        return raw
+
+
+# ---------------------------------------------------------------------------
 # the injector
 # ---------------------------------------------------------------------------
 
@@ -310,6 +446,10 @@ class FaultInjector:
     applied: List[dict] = field(default_factory=list)
     skipped: int = 0
     crashes_applied: int = 0
+    # byzantine wrappers armed by equivocate/fork_checkpoint events (a
+    # byzantine replica does not heal: wraps persist to run end); their
+    # per-wrapper ``injections`` counters feed the bench record
+    byzantine: List = field(default_factory=list)
     _restores: List = field(default_factory=list)
     # per-knob active-window refcounts + the pre-schedule baselines:
     # overlapping windows must restore the BASELINE when the last one
@@ -322,6 +462,11 @@ class FaultInjector:
     def applied_count(self) -> int:
         """Events that actually took effect (skipped ones excluded)."""
         return sum(1 for rec in self.applied if rec.get("applied"))
+
+    @property
+    def byzantine_injections(self) -> int:
+        """Frames the armed byzantine wrappers actually forged."""
+        return sum(w.injections for w in self.byzantine)
 
     async def run(self, stop_at: float) -> None:
         """Fire events at their offsets until done or ``stop_at``
@@ -362,6 +507,8 @@ class FaultInjector:
             ok = self._slow_window(ev)
         elif ev.kind == "stall_device":
             ok = self._stall(ev)
+        elif ev.kind in ("equivocate", "fork_checkpoint"):
+            ok = self._byzantine(ev)
         else:
             ok = False
         rec["applied"] = ok
@@ -396,6 +543,37 @@ class FaultInjector:
             return False
         r.kill()
         self.crashes_applied += 1
+        return True
+
+    def _byzantine(self, ev: FaultEvent) -> bool:
+        """Arm a byzantine transport wrapper on the target replica (the
+        named one, or the live primary — the equivocation case only
+        bites at a primary anyway). Needs the committee's key store to
+        produce VALID signatures over the lying content; idempotent per
+        (replica, wrapper kind)."""
+        if ev.target:
+            r = next(
+                (x for x in self.committee.replicas
+                 if x.id == ev.target and x._running),
+                None,
+            )
+        else:
+            r = self._live_primary()
+        if r is None:
+            return False
+        keys = getattr(self.committee, "keys", None)
+        kp = keys.get(r.id) if keys else None
+        if kp is None:
+            return False  # no key material: cannot sign the forks
+        cls = (
+            EquivocatingPrimary if ev.kind == "equivocate"
+            else ForkingCheckpointer
+        )
+        if isinstance(r.transport, cls):
+            return False  # already byzantine this way
+        wrapper = cls(r.transport, Signer(r.id, kp.seed))
+        r.transport = wrapper
+        self.byzantine.append(wrapper)
         return True
 
     def _net_window(self, ev: FaultEvent) -> bool:

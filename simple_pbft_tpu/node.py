@@ -103,6 +103,11 @@ def _dump_final(node_id: str, replica, transport, watchdog=None) -> None:
         # ever shedding, did the device watchdog fire, how deep did the
         # pending pile get — the post-mortem for any degraded window
         logging.info("%s: verify service %s", node_id, svc.snapshot())
+    auditor = getattr(replica, "auditor", None)
+    if auditor is not None:
+        # the accountability summary: did this node witness any safety
+        # violation, and where its evidence ledger lives (docs/AUDIT.md)
+        logging.info("%s: audit %s", node_id, auditor.snapshot())
     if watchdog is not None:
         try:
             # a DISTINCT file: the shutdown snapshot must never overwrite
@@ -170,6 +175,17 @@ async def run_node(args) -> None:
             path=os.path.join(log_dir, f"{args.id}.trace.jsonl"),
         )
         replica.tracer = tracer
+    auditor = None
+    if args.audit and log_dir:
+        # consensus audit plane (ISSUE 5): online safety-invariant
+        # monitor over the verified message stream; violations become
+        # tamper-evident records in <log-dir>/<id>.evidence.jsonl and
+        # per-slot observations in <id>.audit.jsonl for the cross-node
+        # divergence join (tools/ledger_audit.py, docs/AUDIT.md)
+        from .audit import SafetyAuditor
+
+        auditor = SafetyAuditor(args.id, dep.cfg, log_dir=log_dir)
+        replica.auditor = auditor
     lag = LoopLagGauge()
     telemetry = NodeTelemetry(
         args.id, replica=replica, transport=transport, tracer=tracer,
@@ -215,6 +231,10 @@ async def run_node(args) -> None:
                 flight=recorder,
             )
             watchdog.start()
+            if auditor is not None:
+                # a safety violation triggers the same forensic dump
+                # path as a stall (docs/AUDIT.md)
+                auditor.attach_watchdog(watchdog)
         logging.info(
             "%s listening on %s (verifier=%s, n=%d, f=%d)",
             args.id, dep.addr(args.id), args.verifier, dep.cfg.n, dep.cfg.f,
@@ -242,6 +262,8 @@ async def run_node(args) -> None:
                 await status.stop()
             if tracer is not None:
                 tracer.close()
+            if auditor is not None:
+                auditor.close()
         except Exception:
             logging.exception("%s: telemetry teardown failed", args.id)
         _dump_final(args.id, replica, transport, watchdog=watchdog)
@@ -312,6 +334,16 @@ def main() -> None:
         "debug mode; 0 = off. Sampling loss is counted in the "
         "snapshot's tracer.trace_dropped. Events go to "
         "<log-dir>/<id>.trace.jsonl",
+    )
+    ap.add_argument(
+        "--audit", type=int, default=1,
+        help="online safety auditor (needs a log dir): checks "
+        "equivocation / checkpoint-consistency / commit-uniqueness / "
+        "certificate-honesty invariants over the verified message "
+        "stream, appends tamper-evident evidence to "
+        "<log-dir>/<id>.evidence.jsonl and per-slot observations to "
+        "<id>.audit.jsonl (joined across nodes by "
+        "tools/ledger_audit.py); 0 disables (docs/AUDIT.md)",
     )
     ap.add_argument(
         "--stall-deadline", type=float, default=30.0,

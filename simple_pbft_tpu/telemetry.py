@@ -53,8 +53,12 @@ from typing import Any, Dict, List, Optional
 
 log = logging.getLogger("pbft.telemetry")
 
-# Bump when a snapshot/trace field is renamed or removed (additions are
-# compatible): consumers (pbft_top, bench joins) key off this.
+# The snapshot/trace/evidence stability contract (docs/OBSERVABILITY.md):
+# ADDING a field is always compatible and does NOT bump this; RENAMING or
+# REMOVING one (or changing a field's meaning) bumps it. Consumers
+# (pbft_top, CI scrapers, bench joins, ledger_audit) pin their parsing to
+# this number — it rides every snapshot as BOTH the historical ``schema``
+# key and, since ISSUE 5, the explicit top-level ``schema_version``.
 SCHEMA_VERSION = 1
 
 
@@ -152,7 +156,8 @@ class NodeTelemetry:
     def snapshot(self) -> Dict[str, Any]:
         now = time.monotonic()
         snap: Dict[str, Any] = {
-            "schema": SCHEMA_VERSION,
+            "schema": SCHEMA_VERSION,  # historical spelling, kept stable
+            "schema_version": SCHEMA_VERSION,
             "node": self.node_id,
             "t_wall": round(time.time(), 3),
             "t_mono": round(now, 3),
@@ -161,6 +166,12 @@ class NodeTelemetry:
         if self.replica is not None:
             snap["replica"] = replica_snapshot(self.replica)
             snap["verify"] = verify_service_snapshot(self.replica.verifier)
+            auditor = getattr(self.replica, "auditor", None)
+            if auditor is not None:
+                # consensus audit plane (ISSUE 5): violation/observation
+                # counters + the evidence chain head — pbft_top's AUD
+                # column and the CI audit smoke read this
+                snap["audit"] = auditor.snapshot()
             lane = qc_lane_snapshot()
             if lane is not None:
                 # QC-plane fast path (ISSUE 3): certificate-verify queue
@@ -583,6 +594,10 @@ class ProgressWatchdog:
                 "has_block": inst.block is not None,
                 "prepare_qc": inst.prepare_qc is not None,
                 "commit_qc": inst.commit_qc is not None,
+                # conflicting-digest rejections this slot turned away: a
+                # contested slot (fork in flight) reads differently from
+                # a merely starved one in a wedge autopsy
+                "conflicts": len(getattr(inst, "conflicts", ())),
             })
         return rows
 
@@ -744,7 +759,8 @@ def write_status_file(log_dir: str, node_id: str, port: int) -> str:
     with open(path, "w") as fh:
         json.dump(
             {"node": node_id, "host": "127.0.0.1", "port": port,
-             "pid": os.getpid(), "schema": SCHEMA_VERSION},
+             "pid": os.getpid(), "schema": SCHEMA_VERSION,
+             "schema_version": SCHEMA_VERSION},
             fh,
         )
     return path

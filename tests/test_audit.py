@@ -1,0 +1,570 @@
+"""Consensus audit plane (ISSUE 5): online safety-invariant monitor,
+tamper-evident evidence ledger, byzantine injectors, and the cross-node
+divergence auditor (tools/ledger_audit.py).
+
+The acceptance criteria under test:
+- an honest committee soak produces ZERO evidence records (the
+  false-positive guard) and a clean-bill divergence report;
+- an injected equivocation produces evidence naming exactly the faulty
+  replica, whose signatures re-verify;
+- a corrupted evidence line is rejected by ledger_audit with a nonzero
+  exit.
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+import pytest
+
+from simple_pbft_tpu.audit import (
+    GENESIS,
+    SafetyAuditor,
+    chain_hash,
+    parse_evidence,
+    reverify_record,
+    substantiate_record,
+)
+from simple_pbft_tpu.committee import LocalCommittee
+from simple_pbft_tpu.config import make_test_committee
+from simple_pbft_tpu.crypto.signer import Signer
+from simple_pbft_tpu.faults import (
+    EquivocatingPrimary,
+    FaultEvent,
+    FaultInjector,
+    FaultSchedule,
+    ForkingCheckpointer,
+)
+from simple_pbft_tpu.messages import (
+    Checkpoint,
+    Commit,
+    NewView,
+    PrePrepare,
+    Prepare,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import ledger_audit  # noqa: E402  (tools/ is not a package)
+import pbft_top  # noqa: E402
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def _committee_cfg(n=4):
+    cfg, keys = make_test_committee(n=n)
+    return cfg, keys
+
+
+def _signed(keys, rid, cls, **fields):
+    msg = cls(**fields)
+    Signer(rid, keys[rid].seed).sign_msg(msg)
+    return msg
+
+
+# ---------------------------------------------------------------------------
+# unit: invariant checks + evidence chain
+# ---------------------------------------------------------------------------
+
+
+def test_equivocating_votes_detected_and_resends_ignored():
+    cfg, keys = _committee_cfg()
+    aud = SafetyAuditor("obs", cfg)
+    a = _signed(keys, "r1", Prepare, view=0, seq=3, digest="aa" * 32)
+    b = _signed(keys, "r1", Prepare, view=0, seq=3, digest="bb" * 32)
+    aud.observe_message(a)
+    aud.observe_message(a)  # byte-identical resend: not evidence
+    assert aud.violations == 0
+    aud.observe_message(b)
+    assert aud.violations == 1
+    assert aud.by_kind == {"equivocation": 1}
+    assert aud.last_accused == ["r1"]
+    aud.observe_message(b)  # the conflicting pair again: deduped
+    assert aud.violations == 1
+    rec = aud.recent()[0]
+    # the two conflicting signed messages ride the record VERBATIM and
+    # re-verify against the committee's published keys
+    assert [m["digest"] for m in rec["msgs"]] == ["aa" * 32, "bb" * 32]
+    assert reverify_record(cfg, rec)
+    # a vote from a different sender with a different digest is not
+    # equivocation (false-positive guard)
+    c = _signed(keys, "r2", Prepare, view=0, seq=3, digest="cc" * 32)
+    aud.observe_message(c)
+    assert aud.violations == 1
+
+
+def test_preprepare_equivocation_names_primary_and_reverifies():
+    cfg, keys = _committee_cfg()
+    aud = SafetyAuditor("obs", cfg)
+    blk_a = [{"kind": "request", "client_id": "c0", "sender": "c0",
+              "timestamp": 1, "operation": "put a 1", "sig": "", "ack": 0}]
+    pa = _signed(keys, "r0", PrePrepare, view=0, seq=1,
+                 digest=PrePrepare.block_digest(blk_a), block=blk_a)
+    pb = _signed(keys, "r0", PrePrepare, view=0, seq=1,
+                 digest=PrePrepare.block_digest([]), block=[])
+    aud.observe_message(pa)
+    aud.observe_message(pb)
+    assert aud.by_kind == {"equivocation": 1}
+    rec = aud.recent()[0]
+    assert rec["accused"] == ["r0"] and rec["attribution"] == "proof"
+    # evidence pre-prepares are block-DETACHED and still re-verify (the
+    # signature covers the detached payload)
+    assert all(m["block"] == [] for m in rec["msgs"])
+    assert reverify_record(cfg, rec)
+
+
+def test_checkpoint_divergence_and_equivocation():
+    cfg, keys = _committee_cfg()
+    aud = SafetyAuditor("r0", cfg)
+    own = _signed(keys, "r0", Checkpoint, seq=4, state_digest="11" * 32)
+    peer_ok = _signed(keys, "r1", Checkpoint, seq=4, state_digest="11" * 32)
+    peer_bad = _signed(keys, "r2", Checkpoint, seq=4, state_digest="22" * 32)
+    aud.observe_message(peer_ok)  # peer first, before our own executes
+    aud.observe_message(own)
+    assert aud.violations == 0  # matching digests: clean
+    aud.observe_message(peer_bad)
+    assert aud.by_kind == {"checkpoint_divergence": 1}
+    rec = aud.recent()[0]
+    assert rec["accused"] == ["r2"] and rec["attribution"] == "divergence"
+    assert reverify_record(cfg, rec)
+    # same sender, same seq, second digest: proof-grade equivocation
+    peer_flip = _signed(keys, "r1", Checkpoint, seq=4,
+                        state_digest="33" * 32)
+    aud.observe_message(peer_flip)
+    assert aud.by_kind["checkpoint_equivocation"] == 1
+
+
+def test_commit_fork_detected():
+    cfg, _ = _committee_cfg()
+    aud = SafetyAuditor("r0", cfg)
+    aud.observe_commit(0, 7, "aa" * 32)
+    aud.observe_commit(0, 8, "ab" * 32)  # next seq: fine
+    assert aud.violations == 0
+    aud._on_committed(1, 7, "bb" * 32, None)  # conflicting certificate
+    assert aud.by_kind == {"commit_fork": 1}
+
+
+def test_rejected_new_view_needs_valid_envelope():
+    cfg, keys = _committee_cfg()
+    aud = SafetyAuditor("obs", cfg)
+    # primary(1) = r1 for the 4-replica test committee
+    nv = _signed(keys, "r1", NewView, new_view=1, viewchange_proof=[])
+    forged = NewView(new_view=1, viewchange_proof=[])
+    forged.sender, forged.sig = "r1", "00" * 64  # forged envelope
+    aud.observe_rejected_new_view(forged)
+    assert aud.violations == 0  # a forgery must not frame r1
+    aud.observe_rejected_new_view(nv)
+    assert aud.by_kind == {"newview_invalid": 1}
+    assert aud.recent()[0]["accused"] == ["r1"]
+    assert reverify_record(cfg, aud.recent()[0])
+
+
+def test_evidence_chain_is_tamper_evident(tmp_path):
+    cfg, keys = _committee_cfg()
+    aud = SafetyAuditor("r9", cfg, log_dir=str(tmp_path))
+    for seq in (3, 4):
+        aud.observe_message(
+            _signed(keys, "r1", Prepare, view=0, seq=seq, digest="aa" * 32))
+        aud.observe_message(
+            _signed(keys, "r1", Prepare, view=0, seq=seq, digest="bb" * 32))
+    aud.close()
+    path = tmp_path / "r9.evidence.jsonl"
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2
+    recs, err = parse_evidence(lines)
+    assert err is None and len(recs) == 2
+    assert recs[0]["prev"] == GENESIS
+    assert recs[1]["prev"] == recs[0]["h"] == chain_hash(recs[0])
+    # tamper with record 1's content: its own hash breaks
+    bad = json.loads(lines[0])
+    bad["detail"] = "history rewritten"
+    _, err = parse_evidence([json.dumps(bad, sort_keys=True), lines[1]])
+    assert err is not None and "tamper" in err
+    # drop record 1: record 2's prev link breaks
+    _, err = parse_evidence([lines[1]])
+    assert err is not None and "chain" in err
+    # undecodable line
+    _, err = parse_evidence(["{not json", lines[1]])
+    assert err is not None and "undecodable" in err
+
+
+def test_violation_triggers_autopsy_dump(tmp_path):
+    from simple_pbft_tpu.telemetry import NodeTelemetry, ProgressWatchdog
+
+    cfg, keys = _committee_cfg()
+    wd = ProgressWatchdog(
+        NodeTelemetry("r0"), path=str(tmp_path / "r0.autopsy.json"))
+    aud = SafetyAuditor("r0", cfg, watchdog=wd)
+    aud.observe_message(
+        _signed(keys, "r1", Prepare, view=0, seq=1, digest="aa" * 32))
+    aud.observe_message(
+        _signed(keys, "r1", Prepare, view=0, seq=1, digest="bb" * 32))
+    assert wd.dumps == 1
+    doc = json.loads((tmp_path / "r0.autopsy.json").read_text())
+    assert "safety violation: equivocation" in doc["reason"]
+    # one autopsy per auditor: a second violation doesn't re-dump
+    aud.observe_message(
+        _signed(keys, "r1", Prepare, view=0, seq=2, digest="aa" * 32))
+    aud.observe_message(
+        _signed(keys, "r1", Prepare, view=0, seq=2, digest="bb" * 32))
+    assert aud.violations == 2 and wd.dumps == 1
+
+
+def test_gc_folds_stores_at_watermark():
+    cfg, keys = _committee_cfg()
+    aud = SafetyAuditor("r0", cfg)
+    for seq in (1, 5):
+        aud.observe_message(
+            _signed(keys, "r1", Prepare, view=0, seq=seq, digest="aa" * 32))
+        aud.observe_commit(0, seq, "cc" * 32)
+    aud.observe_message(
+        _signed(keys, "r0", Checkpoint, seq=4, state_digest="dd" * 32))
+    aud.gc(4)
+    assert list(aud._votes) == [("r1", 0, 5, "prepare")]
+    assert list(aud._commits) == [5]
+    assert list(aud._ckpts) == [4]  # the stable checkpoint itself stays
+
+
+# ---------------------------------------------------------------------------
+# snapshot surfaces + pbft_top
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_audit_block_and_schema_version():
+    from simple_pbft_tpu.telemetry import SCHEMA_VERSION
+
+    async def main():
+        com = LocalCommittee.build(n=4, clients=1)
+        auds = com.attach_auditors()
+        com.start()
+        try:
+            assert await com.clients[0].submit("put s 1") == "ok"
+            snap = com.node_telemetry("r0").snapshot()
+            assert snap["schema_version"] == SCHEMA_VERSION
+            assert snap["schema"] == SCHEMA_VERSION  # back-compat spelling
+            aud = snap["audit"]
+            assert aud["violations"] == 0
+            assert aud["observations"] >= 1
+            assert aud["chain_head"] == GENESIS
+        finally:
+            await com.stop()
+            for a in auds.values():
+                a.close()
+
+    run(main())
+
+
+def test_pbft_top_aud_column_and_evidence_fallback(tmp_path):
+    snap = {"node": "r0", "replica": {"metrics": {}},
+            "audit": {"violations": 2, "last_accused": "r0"}}
+    row = pbft_top.row_from_snapshot(snap, "http", None, 1.0)
+    assert row[pbft_top.COLUMNS.index("AUD")] == "2:r0"
+    clean = {"node": "r0", "replica": {"metrics": {}},
+             "audit": {"violations": 0}}
+    row = pbft_top.row_from_snapshot(clean, "http", None, 1.0)
+    assert row[pbft_top.COLUMNS.index("AUD")] == "0"
+    # post-mortem fallback: synthesize the audit block from the ledger
+    cfg, keys = _committee_cfg()
+    aud = SafetyAuditor("r7", cfg, log_dir=str(tmp_path))
+    aud.observe_message(
+        _signed(keys, "r2", Prepare, view=0, seq=1, digest="aa" * 32))
+    aud.observe_message(
+        _signed(keys, "r2", Prepare, view=0, seq=1, digest="bb" * 32))
+    aud.close()
+    summ = pbft_top.evidence_summary(str(tmp_path / "r7.evidence.jsonl"))
+    assert summ == {"violations": 1, "last_kind": "equivocation",
+                    "last_accused": "r2"}
+    _, _, evidence = pbft_top.discover(str(tmp_path))
+    assert evidence == {"r7": str(tmp_path / "r7.evidence.jsonl")}
+
+
+# ---------------------------------------------------------------------------
+# byzantine injectors (faults.py satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_schedule_parses_byzantine_kinds_deterministically():
+    ids = [f"r{i}" for i in range(4)]
+    s = FaultSchedule.parse("seed=9,equiv=1,forkckpt=2", horizon=10.0,
+                            replica_ids=ids)
+    kinds = sorted(e.kind for e in s.events)
+    assert kinds == ["equivocate", "fork_checkpoint", "fork_checkpoint"]
+    assert s == FaultSchedule.parse("seed=9,equiv=1,forkckpt=2",
+                                    horizon=10.0, replica_ids=ids)
+    assert s.summary()["counts"] == {"equivocate": 1, "fork_checkpoint": 2}
+    with pytest.raises(ValueError, match="equivv"):
+        FaultSchedule.parse("equivv=1", horizon=10.0)
+
+
+def test_injector_arms_byzantine_wrappers_idempotently():
+    async def main():
+        com = LocalCommittee.build(n=4, clients=1)
+        com.start()
+        inj = FaultInjector(
+            committee=com,
+            schedule=FaultSchedule.generate(seed=1, horizon=1.0),
+        )
+        try:
+            inj._apply(FaultEvent(t=0, kind="equivocate"))
+            assert inj.applied[-1]["applied"] is True
+            assert isinstance(com.replica("r0").transport,
+                              EquivocatingPrimary)
+            inj._apply(FaultEvent(t=0, kind="fork_checkpoint", target="r2"))
+            assert isinstance(com.replica("r2").transport,
+                              ForkingCheckpointer)
+            # re-arming the same wrapper kind is a no-op, not a stack
+            inj._apply(FaultEvent(t=0, kind="equivocate"))
+            assert inj.applied[-1]["applied"] is False
+            assert len(inj.byzantine) == 2
+            assert inj.byzantine_injections == 0  # nothing forged yet
+        finally:
+            await com.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# end to end: soak / equivocation / checkpoint fork / corrupted ledger
+# ---------------------------------------------------------------------------
+
+
+def test_honest_soak_zero_evidence_and_clean_bill(tmp_path):
+    """The false-positive guard: an honest committee crossing several
+    checkpoint folds yields zero evidence records, no evidence FILES at
+    all (the sink is lazy), and a clean-bill report with exit 0."""
+
+    async def main():
+        com = LocalCommittee.build(n=4, clients=2, checkpoint_interval=4)
+        auds = com.attach_auditors(log_dir=str(tmp_path))
+        com.start()
+        try:
+            for i in range(8):
+                for j, cl in enumerate(com.clients):
+                    assert await cl.submit(f"put h{j}_{i} {i}") == "ok"
+            await asyncio.sleep(0.3)  # let trailing checkpoints settle
+        finally:
+            await com.stop()
+            for a in auds.values():
+                a.close()
+        for rid, a in auds.items():
+            assert a.violations == 0, (rid, a.snapshot())
+            assert a.observations > 0, rid
+        assert not list(tmp_path.glob("*.evidence.jsonl"))
+        cfg, _ = _committee_cfg()
+        report, code = ledger_audit.run_audit([str(tmp_path)], cfg=cfg)
+        assert code == 0, report
+        assert report["clean"] is True
+        assert report["commit_matrix"]["agree"] is True
+        assert report["commit_matrix"]["seqs"] >= 8
+        assert report["checkpoint_matrix"]["agree"] is True
+        assert report["accused"] == []
+
+    run(main())
+
+
+def test_equivocating_primary_accused_with_reverified_signatures(tmp_path):
+    """The acceptance scenario: r0 forks its pre-prepares to disjoint
+    halves; the cross-node ledger join (and any online sighting via the
+    repair path) must accuse exactly r0, signatures re-verified."""
+
+    async def main():
+        com = LocalCommittee.build(n=4, clients=1, view_timeout=1.0,
+                                   checkpoint_interval=8)
+        auds = com.attach_auditors(log_dir=str(tmp_path))
+        evil = com.replica("r0")
+        evil.transport = EquivocatingPrimary(
+            evil.transport, Signer("r0", com.keys["r0"].seed))
+        com.clients[0].request_timeout = 2.0
+        com.start()
+        ok = 0
+        try:
+            for i in range(10):
+                try:
+                    r = await com.clients[0].submit(f"put e{i} {i}",
+                                                    retries=8)
+                    ok += 1 if r == "ok" else 0
+                except Exception:
+                    pass
+        finally:
+            await com.stop()
+            for a in auds.values():
+                a.close()
+        assert evil.transport.injections >= 1
+        assert ok >= 4, ok  # liveness: the honest quorum keeps committing
+        cfg, _ = _committee_cfg()
+        report, code = ledger_audit.run_audit([str(tmp_path)], cfg=cfg)
+        assert code == 1, report
+        assert report["accused"] == ["r0"], report["accused"]
+        # SAFETY: honest nodes never committed diverging digests
+        assert report["commit_matrix"]["agree"] is True
+        # the accusation rests on re-verified signatures: either a
+        # proposal-join fork or proof-grade evidence, never hearsay
+        assert report["proposal_forks"] or any(
+            a["verified"] for a in report["accusations"])
+        for f in report["proposal_forks"]:
+            assert f["verified"] is True and f["accused"] == ["r0"]
+        assert report["evidence"]["signature_failures"] == 0
+
+    run(main())
+
+
+def test_forking_checkpointer_accused_by_every_honest_node(tmp_path):
+    async def main():
+        com = LocalCommittee.build(n=4, clients=1, checkpoint_interval=4)
+        auds = com.attach_auditors(log_dir=str(tmp_path))
+        evil = com.replica("r3")
+        evil.transport = ForkingCheckpointer(
+            evil.transport, Signer("r3", com.keys["r3"].seed))
+        com.start()
+        try:
+            for i in range(12):
+                assert await com.clients[0].submit(f"put f{i} {i}") == "ok"
+            await asyncio.sleep(0.3)
+        finally:
+            await com.stop()
+            for a in auds.values():
+                a.close()
+        assert evil.transport.injections >= 1
+        # every honest node independently produced divergence evidence
+        for rid in ("r0", "r1", "r2"):
+            assert auds[rid].by_kind.get("checkpoint_divergence"), rid
+            assert auds[rid].accused_ever == {"r3"}
+        assert auds["r3"].violations == 0  # its own state is honest
+        cfg, _ = _committee_cfg()
+        report, code = ledger_audit.run_audit([str(tmp_path)], cfg=cfg)
+        assert code == 1
+        assert report["accused"] == ["r3"]
+        assert report["evidence"]["signature_failures"] == 0
+
+    run(main())
+
+
+def test_framing_evidence_not_substantiated(tmp_path):
+    """A byzantine node's SELF-AUTHORED ledger must not frame honest
+    replicas: records whose (validly signed) messages do not constitute
+    the claimed violation accuse nobody and flag the ledger."""
+    cfg, keys = _committee_cfg()
+    # valid signatures, but the same digest twice: NOT equivocation
+    same = [
+        _signed(keys, "r0", Prepare, view=0, seq=1, digest="aa" * 32)
+        .to_dict()
+        for _ in range(2)
+    ]
+    framed = {"kind": "equivocation", "accused": ["r0"],
+              "attribution": "proof", "msgs": same}
+    assert not substantiate_record(cfg, framed)
+    # empty msgs under a proof kind: also unsubstantiated
+    assert not substantiate_record(
+        cfg, {"kind": "equivocation", "accused": ["r0"], "msgs": []})
+    # cross-phase pair (a prepare for X plus a commit for Y): not a slot
+    mixed = [
+        _signed(keys, "r0", Prepare, view=0, seq=1,
+                digest="aa" * 32).to_dict(),
+        _signed(keys, "r0", Commit, view=0, seq=1,
+                digest="bb" * 32).to_dict(),
+    ]
+    assert not substantiate_record(
+        cfg, {"kind": "equivocation", "accused": ["r0"], "msgs": mixed})
+    # a genuine pair substantiates
+    real = [
+        _signed(keys, "r0", Prepare, view=0, seq=1,
+                digest="aa" * 32).to_dict(),
+        _signed(keys, "r0", Prepare, view=0, seq=1,
+                digest="bb" * 32).to_dict(),
+    ]
+    assert substantiate_record(
+        cfg, {"kind": "equivocation", "accused": ["r0"], "msgs": real})
+    # end to end: a hand-forged (but correctly hash-chained) framing
+    # ledger yields unsubstantiated + exit 2, and r0 is NOT accused
+    rec = {"evt": "violation", "schema_version": 1, "node": "evil",
+           "t_wall": 0.0, "kind": "equivocation", "accused": ["r0"],
+           "attribution": "proof", "detail": "framed", "msgs": same,
+           "prev": GENESIS}
+    rec["h"] = chain_hash(rec)
+    (tmp_path / "evil.evidence.jsonl").write_text(
+        json.dumps(rec, sort_keys=True) + "\n")
+    report, code = ledger_audit.run_audit([str(tmp_path)], cfg=cfg)
+    assert code == 2, report
+    assert report["accused"] == []
+    assert report["evidence"]["unsubstantiated"] == 1
+
+
+def test_framing_proposal_observation_not_a_fork(tmp_path):
+    """A fabricated proposal observation (a REAL signed message filed
+    under the wrong slot/digest) must not produce a fork accusation."""
+    cfg, keys = _committee_cfg()
+    blk = []
+    real = _signed(keys, "r0", PrePrepare, view=0, seq=1,
+                   digest=PrePrepare.block_digest(blk), block=blk)
+    # honest ledger: the real proposal, filed truthfully
+    honest = {"evt": "proposal", "sender": "r0", "view": 0, "seq": 1,
+              "digest": real.digest, "msg": real.to_dict()}
+    # byzantine ledger: the SAME real signed message filed under a
+    # different digest — signature-valid, content-unbound
+    lie = {"evt": "proposal", "sender": "r0", "view": 0, "seq": 1,
+           "digest": "ff" * 32, "msg": real.to_dict()}
+    (tmp_path / "good.audit.jsonl").write_text(json.dumps(honest) + "\n")
+    (tmp_path / "evil.audit.jsonl").write_text(json.dumps(lie) + "\n")
+    report, code = ledger_audit.run_audit([str(tmp_path)], cfg=cfg)
+    assert report["accused"] == [], report
+    assert report["proposal_forks"] == []
+    assert report["evidence"]["unverified_forks"] == 1
+    assert code == 2  # a lying ledger is a corrupt ledger
+
+
+def test_non_primary_new_view_evidence_substantiates(tmp_path):
+    """A BACKUP signing a NEW-VIEW is misbehavior too: the online record
+    against it must survive offline substantiation (regression: the
+    offline check once required sender == primary, misclassifying the
+    honest reporter's ledger as a framing attempt)."""
+    cfg, keys = _committee_cfg()
+    aud = SafetyAuditor("obs", cfg, log_dir=str(tmp_path))
+    # r3 is NOT primary of view 1 (that's r1): validate_new_view rejects
+    nv = _signed(keys, "r3", NewView, new_view=1, viewchange_proof=[])
+    aud.observe_rejected_new_view(nv)
+    aud.close()
+    assert aud.by_kind == {"newview_invalid": 1}
+    assert aud.last_accused == ["r3"]
+    assert substantiate_record(cfg, aud.recent()[0])
+    report, code = ledger_audit.run_audit([str(tmp_path)], cfg=cfg)
+    assert code == 1, report
+    assert report["accused"] == ["r3"]
+    assert report["evidence"]["unsubstantiated"] == 0
+
+
+def test_rejected_new_view_envelope_checks_bounded():
+    cfg, keys = _committee_cfg()
+    aud = SafetyAuditor("obs", cfg)
+    forged = NewView(new_view=1, viewchange_proof=[])
+    forged.sender, forged.sig = "r1", "00" * 64
+    for _ in range(SafetyAuditor.MAX_ENVELOPE_CHECKS + 10):
+        aud.observe_rejected_new_view(forged)
+    assert aud._envelope_checks == SafetyAuditor.MAX_ENVELOPE_CHECKS
+    assert aud.violations == 0
+
+
+def test_corrupted_evidence_rejected_nonzero_exit(tmp_path):
+    cfg, keys = _committee_cfg()
+    aud = SafetyAuditor("r1", cfg, log_dir=str(tmp_path))
+    for seq in (1, 2):
+        aud.observe_message(
+            _signed(keys, "r2", Prepare, view=0, seq=seq, digest="aa" * 32))
+        aud.observe_message(
+            _signed(keys, "r2", Prepare, view=0, seq=seq, digest="bb" * 32))
+    aud.close()
+    path = tmp_path / "r1.evidence.jsonl"
+    report, code = ledger_audit.run_audit([str(tmp_path)], cfg=cfg)
+    assert code == 1 and report["evidence"]["chains_ok"]
+    # flip one field inside the FIRST record: self-hash breaks
+    lines = path.read_text().splitlines()
+    rec = json.loads(lines[0])
+    rec["accused"] = ["r0"]  # frame someone else
+    lines[0] = json.dumps(rec, sort_keys=True)
+    path.write_text("\n".join(lines) + "\n")
+    report, code = ledger_audit.run_audit([str(tmp_path)], cfg=cfg)
+    assert code == 2, report
+    assert not report["evidence"]["chains_ok"]
+    assert report["evidence"]["corrupt"][0]["node"] == "r1"

@@ -32,6 +32,7 @@ def test_same_seed_replays_identically():
     kw = dict(
         horizon=30.0, crashes=3, drop_windows=2, delay_windows=1,
         slow_verifier_windows=1, device_stalls=2,
+        equivocators=1, checkpoint_forkers=1,
         replica_ids=[f"r{i}" for i in range(16)],
     )
     a = FaultSchedule.generate(seed=42, **kw)

@@ -1,0 +1,447 @@
+#!/usr/bin/env python3
+"""ledger_audit: cross-node divergence auditor over the audit plane's ledgers.
+
+Joins every node's observation ledger (``*.audit.jsonl`` — per-slot
+proposal / checkpoint / commit lines written by ``audit.SafetyAuditor``)
+and evidence ledger (``*.evidence.jsonl`` — hash-chained violation
+records) from one or more log directories and prints a divergence
+report:
+
+- per-seq COMMIT digest agreement matrix (first divergent seq, who
+  disagrees) — the "did the committee fork" answer;
+- per-seq CHECKPOINT digest agreement matrix — the "did replicated
+  state silently diverge" answer;
+- PROPOSAL forks: the same primary signing two different digests at one
+  (view, seq) across different nodes' ledgers — the equivocation no
+  single node sees when the halves are disjoint
+  (faults.EquivocatingPrimary);
+- EVIDENCE: every node's violation records, chain-verified (a tampered
+  or truncated ledger is REJECTED with a nonzero exit) and
+  signature-re-verified against the committee's published keys through
+  the same Ed25519 batch / BLS pairing verifiers consensus uses;
+- the resulting ACCUSED set (proof-grade evidence + confirmed
+  divergence), or a clean bill for honest runs.
+
+Keys: ``--deploy-dir`` (a committee.json deployment) or
+``--test-committee N`` (the deterministic make_test_committee used by
+tests/benchmarks; add ``--qc`` for BLS committees). Without either,
+signatures are reported unverified and nothing is accused on signature
+authority alone.
+
+Exit codes: 0 = clean bill; 1 = accusations or divergence found;
+2 = a ledger is corrupt/tampered or evidence signatures failed.
+
+Usage:
+  python tools/ledger_audit.py --log-dir dep/log [--test-committee 4] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from simple_pbft_tpu.audit import (  # noqa: E402
+    DIVERGENCE,
+    PROOF,
+    parse_evidence,
+    reverify_record,
+    substantiate_record,
+    verify_signed_dicts,
+)
+
+EXIT_CLEAN = 0
+EXIT_ACCUSED = 1
+EXIT_CORRUPT = 2
+
+MAX_DIVERGENT_LISTED = 16  # bound the per-seq detail in the report
+
+
+def _read_lines(path: str) -> List[str]:
+    """One ledger's lines, rotation-aware: ``path.1`` (older) first."""
+    lines: List[str] = []
+    for p in (path + ".1", path):
+        try:
+            with open(p, "r") as fh:
+                lines.extend(fh.read().splitlines())
+        except OSError:
+            continue
+    return lines
+
+
+def load_ledgers(dirs: List[str]) -> Dict[str, Dict[str, Any]]:
+    """node -> {"observations": [dict], "evidence_lines": [str]}."""
+    nodes: Dict[str, Dict[str, Any]] = {}
+
+    def ent(node: str) -> Dict[str, Any]:
+        return nodes.setdefault(
+            node, {"observations": [], "evidence_lines": []}
+        )
+
+    for d in dirs:
+        for path in sorted(glob.glob(os.path.join(d, "*.audit.jsonl"))):
+            node = os.path.basename(path)[: -len(".audit.jsonl")]
+            for ln in _read_lines(path):
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    doc = json.loads(ln)
+                except ValueError:
+                    continue  # torn tail line of a killed node: skip
+                if isinstance(doc, dict):
+                    ent(node)["observations"].append(doc)
+        for path in sorted(glob.glob(os.path.join(d, "*.evidence.jsonl"))):
+            node = os.path.basename(path)[: -len(".evidence.jsonl")]
+            ent(node)["evidence_lines"].extend(_read_lines(path))
+    return nodes
+
+
+def _matrix(per_seq: Dict[int, Dict[str, str]]) -> Dict[str, Any]:
+    """Agreement analysis for seq -> node -> digest."""
+    divergent: Dict[int, Dict[str, List[str]]] = {}
+    for seq, by_node in per_seq.items():
+        digests: Dict[str, List[str]] = {}
+        for node, dg in by_node.items():
+            digests.setdefault(dg, []).append(node)
+        if len(digests) > 1:
+            divergent[seq] = {
+                dg: sorted(nodes) for dg, nodes in digests.items()
+            }
+    return {
+        "seqs": len(per_seq),
+        "agree": not divergent,
+        "first_divergent_seq": min(divergent) if divergent else None,
+        "divergent": {
+            str(s): divergent[s]
+            for s in sorted(divergent)[:MAX_DIVERGENT_LISTED]
+        },
+        "divergent_total": len(divergent),
+    }
+
+
+def _majority_digest(by_node: Dict[str, str]) -> Optional[str]:
+    counts: Dict[str, int] = {}
+    for dg in by_node.values():
+        counts[dg] = counts.get(dg, 0) + 1
+    return max(counts, key=counts.get) if counts else None
+
+
+def run_audit(dirs: List[str], cfg=None) -> Tuple[Dict[str, Any], int]:
+    nodes = load_ledgers(dirs)
+    verifier = None
+    if cfg is not None:
+        from simple_pbft_tpu.crypto.verifier import best_cpu_verifier
+
+        verifier = best_cpu_verifier()
+
+    # -- evidence: chain-verify, then signature-re-verify ---------------
+    corrupt: List[Dict[str, str]] = []
+    evidence: List[Tuple[str, Dict[str, Any]]] = []
+    for node, ent in sorted(nodes.items()):
+        recs, err = parse_evidence(ent["evidence_lines"])
+        if err is not None:
+            corrupt.append({"node": node, "error": err})
+        evidence.extend((node, r) for r in recs)
+
+    sig_failures = 0
+    unsubstantiated = 0
+    verified_records = 0
+    by_kind: Dict[str, int] = {}
+    accused: set = set()
+    accusations: List[Dict[str, Any]] = []
+    # (seq, accused) -> set of accusing nodes, for divergence confirmation
+    div_claims: Dict[Tuple[int, str], Dict[str, Any]] = {}
+    for node, rec in evidence:
+        kind = str(rec.get("kind", "?"))
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+        ok: Optional[bool] = None
+        if cfg is not None:
+            ok = reverify_record(cfg, rec, verifier)
+            if not ok:
+                sig_failures += 1
+                continue  # unverifiable evidence accuses nobody
+            # signatures alone are not enough: a self-authored ledger
+            # could chain valid-but-irrelevant signed messages under a
+            # proof-grade kind to frame an honest replica — the attached
+            # messages must CONSTITUTE the claimed violation
+            if not substantiate_record(cfg, rec):
+                unsubstantiated += 1
+                continue
+            verified_records += 1
+        who = [str(a) for a in (rec.get("accused") or [])]
+        if rec.get("attribution") == PROOF and who:
+            if cfg is not None:  # never accuse on unverified signatures
+                accused.update(who)
+            accusations.append({
+                "source": "evidence", "reporter": node, "kind": kind,
+                "accused": who, "seq": rec.get("seq"),
+                "view": rec.get("view"), "verified": ok,
+                "detail": rec.get("detail", ""),
+            })
+        elif rec.get("attribution") == DIVERGENCE and who:
+            seq = rec.get("seq")
+            for a in who:
+                claim = div_claims.setdefault(
+                    (seq if isinstance(seq, int) else -1, a),
+                    {"accusers": set(), "kind": kind, "verified": ok,
+                     "claimed": None},
+                )
+                claim["accusers"].add(node)
+                # the accused's own SIGNED digest, straight from the
+                # (re-verified) evidence: what they claimed on the wire
+                for m in rec.get("msgs") or []:
+                    if (
+                        isinstance(m, dict)
+                        and m.get("sender") == a
+                        and isinstance(m.get("state_digest"), str)
+                    ):
+                        claim["claimed"] = m["state_digest"]
+
+    # -- observation joins ----------------------------------------------
+    commits: Dict[int, Dict[str, str]] = {}
+    ckpts: Dict[int, Dict[str, str]] = {}
+    # (sender, view, seq) -> digest -> {"nodes": [...], "msg": dict}
+    proposals: Dict[Tuple[str, int, int], Dict[str, Dict[str, Any]]] = {}
+    for node, ent in sorted(nodes.items()):
+        for o in ent["observations"]:
+            evt = o.get("evt")
+            if evt == "commit":
+                if isinstance(o.get("seq"), int) and isinstance(
+                    o.get("digest"), str
+                ):
+                    commits.setdefault(o["seq"], {})[node] = o["digest"]
+            elif evt == "checkpoint":
+                if isinstance(o.get("seq"), int) and isinstance(
+                    o.get("digest"), str
+                ):
+                    ckpts.setdefault(o["seq"], {})[node] = o["digest"]
+            elif evt == "proposal":
+                sender = o.get("sender")
+                view, seq, dg = o.get("view"), o.get("seq"), o.get("digest")
+                if not (
+                    isinstance(sender, str) and isinstance(view, int)
+                    and isinstance(seq, int) and isinstance(dg, str)
+                ):
+                    continue
+                slot = proposals.setdefault((sender, view, seq), {})
+                entd = slot.setdefault(dg, {"nodes": [], "msg": o.get("msg")})
+                entd["nodes"].append(node)
+
+    commit_matrix = _matrix(commits)
+    ckpt_matrix = _matrix(ckpts)
+
+    # -- proposal forks: one signer, one slot, two digests ---------------
+    forks: List[Dict[str, Any]] = []
+    unverified_forks = 0
+    for (sender, view, seq), by_digest in sorted(proposals.items()):
+        if len(by_digest) < 2:
+            continue
+        msgs = [e["msg"] for e in by_digest.values() if e.get("msg")]
+        ok = None
+        if cfg is not None:
+            # every attached message must BE the pre-prepare the
+            # observation line claims — same kind/sender/view/seq AND
+            # the digest it is filed under (observation ledgers are
+            # self-authored: without the binding, a byzantine node
+            # could file r0's real signed PREPARE — or its real
+            # pre-prepare for another digest — under a fabricated slot
+            # and frame r0 as a fork) — and then re-verify (detached
+            # payloads) against the committee keys
+            bound = len(msgs) == len(by_digest) and all(
+                isinstance(e.get("msg"), dict)
+                and e["msg"].get("kind") == "preprepare"
+                and e["msg"].get("sender") == sender
+                and e["msg"].get("view") == view
+                and e["msg"].get("seq") == seq
+                and e["msg"].get("digest") == dg
+                for dg, e in by_digest.items()
+            )
+            ok = bound and verify_signed_dicts(cfg, msgs, verifier)
+            if not ok:
+                unverified_forks += 1
+                continue
+            accused.add(sender)  # never accuse on unverified signatures
+        forks.append({
+            "source": "proposal-join", "accused": [sender],
+            "view": view, "seq": seq,
+            "digests": {
+                dg[:16]: sorted(e["nodes"]) for dg, e in by_digest.items()
+            },
+            "verified": ok,
+        })
+
+    # -- divergence confirmation -----------------------------------------
+    weak = cfg.weak_quorum if cfg is not None else 2
+    for (seq, who), claim in sorted(
+        div_claims.items(), key=lambda kv: (kv[0][0], kv[0][1])
+    ):
+        accusers = sorted(claim["accusers"])
+        majority = _majority_digest(ckpts.get(seq, {}))
+        # f+1 distinct accusers guarantee at least one honest witness;
+        # alternatively the digest the accused SIGNED (extracted from
+        # the re-verified evidence record) losing to the cross-node
+        # ledger majority at that seq confirms the minority position
+        confirmed = len(accusers) >= weak
+        if not confirmed and majority is not None:
+            confirmed = (
+                claim["claimed"] is not None
+                and claim["claimed"] != majority
+            )
+        if confirmed and cfg is not None:
+            accused.add(who)
+            accusations.append({
+                "source": "divergence", "kind": claim["kind"],
+                "accused": [who], "seq": seq, "accusers": accusers,
+                "verified": claim["verified"],
+            })
+
+    clean = (
+        not corrupt and not sig_failures and not unsubstantiated
+        and not evidence
+        and not forks and commit_matrix["agree"] and ckpt_matrix["agree"]
+    )
+    if corrupt or sig_failures or unsubstantiated or unverified_forks:
+        code = EXIT_CORRUPT
+    elif not clean:
+        code = EXIT_ACCUSED
+    else:
+        code = EXIT_CLEAN
+
+    report = {
+        "nodes": sorted(nodes),
+        "dirs": dirs,
+        "keys": (
+            "verified" if cfg is not None else "unavailable (signatures "
+            "not re-verified; pass --deploy-dir or --test-committee)"
+        ),
+        "commit_matrix": commit_matrix,
+        "checkpoint_matrix": ckpt_matrix,
+        "proposal_forks": forks,
+        "evidence": {
+            "records": len(evidence),
+            "by_kind": dict(sorted(by_kind.items())),
+            "chains_ok": not corrupt,
+            "corrupt": corrupt,
+            "signatures_reverified": verified_records,
+            "signature_failures": sig_failures,
+            "unsubstantiated": unsubstantiated,
+            "unverified_forks": unverified_forks,
+        },
+        "accusations": accusations,
+        "accused": sorted(accused),
+        "clean": clean,
+        "exit": code,
+    }
+    return report, code
+
+
+def render(report: Dict[str, Any]) -> str:
+    out = []
+    out.append(
+        f"ledger_audit: {len(report['nodes'])} nodes "
+        f"({', '.join(report['nodes'])}) — keys {report['keys']}"
+    )
+    cm, km = report["commit_matrix"], report["checkpoint_matrix"]
+    out.append(
+        f"  commits:     {cm['seqs']} seqs, "
+        + ("all digests agree" if cm["agree"] else
+           f"{cm['divergent_total']} DIVERGENT "
+           f"(first at seq {cm['first_divergent_seq']})")
+    )
+    for seq, digs in cm["divergent"].items():
+        out.append(f"    seq {seq}: " + "; ".join(
+            f"{dg[:16]}… -> {','.join(nodes)}" for dg, nodes in digs.items()
+        ))
+    out.append(
+        f"  checkpoints: {km['seqs']} seqs, "
+        + ("all digests agree" if km["agree"] else
+           f"{km['divergent_total']} DIVERGENT "
+           f"(first at seq {km['first_divergent_seq']})")
+    )
+    ev = report["evidence"]
+    out.append(
+        f"  evidence:    {ev['records']} records "
+        f"({ev['by_kind'] or 'none'}), chains "
+        + ("OK" if ev["chains_ok"] else "CORRUPT")
+        + (f", {ev['signature_failures']} signature FAILURES"
+           if ev["signature_failures"] else "")
+        + (f", {ev['unsubstantiated']} UNSUBSTANTIATED (framing attempt?)"
+           if ev["unsubstantiated"] else "")
+    )
+    for c in ev["corrupt"]:
+        out.append(f"    REJECTED {c['node']}: {c['error']}")
+    for f in report["proposal_forks"]:
+        out.append(
+            f"  FORK: {f['accused'][0]} signed "
+            f"{len(f['digests'])} digests at (view {f['view']}, "
+            f"seq {f['seq']})"
+            + (" [signatures re-verified]" if f["verified"] else "")
+        )
+    for a in report["accusations"]:
+        out.append(
+            f"  ACCUSE {','.join(a['accused'])}: {a['kind']} "
+            f"(seq {a.get('seq')}, via {a['source']})"
+        )
+    if report["clean"]:
+        out.append("  CLEAN BILL: no evidence, no forks, no divergence.")
+    else:
+        out.append(
+            f"  accused: {', '.join(report['accused']) or '(none named)'}"
+        )
+    return "\n".join(out)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="cross-node divergence audit over audit/evidence ledgers"
+    )
+    ap.add_argument(
+        "--log-dir", action="append", required=True,
+        help="directory with *.audit.jsonl / *.evidence.jsonl "
+        "(repeatable for multi-host runs)",
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON document")
+    ap.add_argument(
+        "--deploy-dir", default=None,
+        help="deployment directory (committee.json) for key material",
+    )
+    ap.add_argument(
+        "--test-committee", type=int, default=0,
+        help="re-derive the deterministic make_test_committee(N) keys "
+        "(the committee tests/benchmarks run)",
+    )
+    ap.add_argument("--qc", action="store_true",
+                    help="with --test-committee: a qc_mode (BLS) committee")
+    args = ap.parse_args()
+
+    cfg = None
+    if args.deploy_dir:
+        from simple_pbft_tpu import deploy
+
+        cfg = deploy.load(
+            os.path.join(args.deploy_dir, "committee.json")
+        ).cfg
+    elif args.test_committee:
+        from simple_pbft_tpu.config import make_test_committee
+
+        cfg, _ = make_test_committee(
+            n=args.test_committee, qc_mode=args.qc
+        )
+
+    report, code = run_audit(args.log_dir, cfg=cfg)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(render(report))
+    sys.exit(code)
+
+
+if __name__ == "__main__":
+    main()

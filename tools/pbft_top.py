@@ -36,7 +36,7 @@ from typing import Dict, List, Optional, Tuple
 COLUMNS = (
     "NODE", "SRC", "VIEW", "ROLE", "EXEC", "STABLE", "CAGE", "BACKLOG",
     "VQ", "QCQ", "QCB", "PAIRms", "SHED", "DEG", "QUAR", "REJ", "WDOG",
-    "RTTms", "LAGms", "REQ/s",
+    "AUD", "RTTms", "LAGms", "REQ/s",
 )
 
 
@@ -68,8 +68,9 @@ def tail_flight(path: str, max_tail: int = 256 * 1024) -> Optional[dict]:
     return None
 
 
-def discover(log_dir: str) -> Tuple[List[str], Dict[str, str]]:
-    """(endpoints, {node: flight_path}) from a node/bench log directory."""
+def discover(log_dir: str) -> Tuple[List[str], Dict[str, str], Dict[str, str]]:
+    """(endpoints, {node: flight_path}, {node: evidence_path}) from a
+    node/bench log directory."""
     endpoints = []
     for path in sorted(glob.glob(os.path.join(log_dir, "*.status.json"))):
         try:
@@ -81,7 +82,60 @@ def discover(log_dir: str) -> Tuple[List[str], Dict[str, str]]:
         os.path.basename(p)[: -len(".flight.jsonl")]: p
         for p in sorted(glob.glob(os.path.join(log_dir, "*.flight.jsonl")))
     }
-    return endpoints, flights
+    evidence = {
+        os.path.basename(p)[: -len(".evidence.jsonl")]: p
+        for p in sorted(glob.glob(os.path.join(log_dir, "*.evidence.jsonl")))
+    }
+    return endpoints, flights, evidence
+
+
+_EVIDENCE_CACHE: Dict[str, Tuple[tuple, Optional[dict]]] = {}
+
+
+def evidence_summary(path: str) -> Optional[dict]:
+    """Post-mortem AUD fallback: synthesize a minimal ``audit`` block
+    from a node's evidence ledger (the auditor only creates the file on
+    the first violation, so existence alone is already a signal).
+    Cached by (mtime, size) — the live loop re-calls this every refresh
+    tick and evidence ledgers can be large. Rotation-aware: the ``.1``
+    backup's records count too."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    key = (st.st_mtime_ns, st.st_size)
+    cached = _EVIDENCE_CACHE.get(path)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    count = 0
+    last_kind = None
+    last_accused = None
+    for p in (path + ".1", path):  # rotated backup first (older records)
+        try:
+            with open(p, "r") as fh:
+                for ln in fh:
+                    ln = ln.strip()
+                    if not ln:
+                        continue
+                    try:
+                        rec = json.loads(ln)
+                    except ValueError:
+                        continue  # torn final line
+                    if rec.get("evt") == "violation":
+                        count += 1
+                        last_kind = rec.get("kind")
+                        last_accused = (
+                            ",".join(rec.get("accused") or []) or None
+                        )
+        except OSError:
+            continue
+    summ = (
+        {"violations": count, "last_kind": last_kind,
+         "last_accused": last_accused}
+        if count else None
+    )
+    _EVIDENCE_CACHE[path] = (key, summ)
+    return summ
 
 
 def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
@@ -90,7 +144,16 @@ def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
     ver = snap.get("verify") or {}
     lane = snap.get("qc_lane") or {}  # QC verify lane (qc-mode runs only)
     lag = snap.get("loop_lag") or {}  # event-loop scheduling delay
+    aud = snap.get("audit") or {}  # safety auditor (evidence counters)
     met = rep.get("metrics") or {}
+    # AUD: evidence count + last accused replica — "2:r0" means two
+    # violations, most recently accusing r0; "0" is an attached auditor
+    # with a clean ledger; blank means no auditor
+    aud_cell = ""
+    if aud:
+        aud_cell = str(aud.get("violations", 0))
+        if aud.get("violations") and aud.get("last_accused"):
+            aud_cell += f":{aud['last_accused']}"
     # commit age: seconds since this node last applied a block — the
     # wedge gauge (a live view with CAGE climbing IS the qc256 shape)
     cage = rep.get("last_commit_age_s")
@@ -122,6 +185,7 @@ def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
         "*" if ver.get("quarantined") else "",
         str(ver.get("overload_rejections", "")),
         str(ver.get("watchdog_failovers", "")),
+        aud_cell,
         (f"{ver['rtt_ms_ema']:.0f}" if "rtt_ms_ema" in ver else ""),
         (f"{lag['ema_ms']:.1f}" if "ema_ms" in lag else ""),
         rate,
@@ -187,13 +251,23 @@ def main() -> None:
     prev_t = time.monotonic()
     while True:
         flights: Dict[str, str] = {}
+        evidence: Dict[str, str] = {}
         found: List[str] = []
         for d in (args.log_dir, args.flight_dir):
             if d:
-                eps, fls = discover(d)
+                eps, fls, evs = discover(d)
                 found.extend(eps)
                 flights.update(fls)
+                evidence.update(evs)
         snaps = gather(endpoints + found, flights)
+        for node, (_, snap) in snaps.items():
+            if "audit" not in snap and node in evidence:
+                # post-mortem fallback: a flight frame predating the
+                # audit plane (or a node whose snapshot lacks the block)
+                # still surfaces its on-disk evidence ledger
+                summ = evidence_summary(evidence[node])
+                if summ is not None:
+                    snap["audit"] = summ
         now = time.monotonic()
         if not snaps:
             print("pbft_top: no nodes found (check --endpoints/--log-dir)",
