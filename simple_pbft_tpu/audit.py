@@ -363,6 +363,22 @@ class SafetyAuditor:
         first one captures the interesting state)."""
         self.watchdog = watchdog
 
+    def on_epoch(self, new_cfg) -> None:
+        """The committee reconfigured (replica._activate_epoch, ISSUE 7):
+        adopt the new membership for key lookups/envelope re-checks and
+        leave an epoch marker in the observation ledger so cross-node
+        joins can segment history by epoch. The invariant stores (votes,
+        checkpoints, commits) deliberately carry over — I1-I4 must hold
+        ACROSS the boundary: a replica that signed conflicting digests
+        straddling an epoch switch is still equivocating. ledger_audit
+        ignores unknown evt kinds by design, so the marker is additive."""
+        self.cfg = new_cfg
+        self._observe({
+            "evt": "epoch",
+            "epoch": getattr(new_cfg, "epoch", 0),
+            "replica_ids": list(new_cfg.replica_ids),
+        })
+
     def close(self) -> None:
         self._evidence.close()
         if self._obs is not None:

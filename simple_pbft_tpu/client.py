@@ -21,10 +21,10 @@ from collections import defaultdict
 from typing import Dict, Optional
 
 from . import spans
-from .config import CommitteeConfig
+from .config import CommitteeConfig, config_from_doc
 from .crypto.signer import Signer
 from .crypto.verifier import BatchItem, Verifier, best_cpu_verifier
-from .messages import Message, Reply, Request
+from .messages import ConfigFetch, ConfigReply, Message, Reply, Request
 from .transport.base import Transport
 
 
@@ -108,6 +108,21 @@ class Client:
         self._bg_tasks: set = set()
         self._task: Optional[asyncio.Task] = None
         self.view_hint = 0  # latest view seen in replies
+        # committee-epoch tracking (ISSUE 7): after a live
+        # reconfiguration this client's address book (cfg.replica_ids)
+        # is stale — any reply carrying a higher epoch triggers a
+        # ConfigFetch round, and f+1 matching signed ConfigReplies from
+        # replicas we ALREADY know rebuild the book (one lying replica
+        # cannot steer us into a fake committee)
+        self._seed = seed
+        self.epoch = cfg.epoch
+        # sender -> its latest (epoch, config-bytes) claim. Keyed by
+        # SENDER, not by claim: each known replica controls exactly one
+        # slot, so a hostile replica signing arbitrarily many distinct
+        # configs only ever overwrites itself — no eviction policy to
+        # game, bounded by the committee size by construction
+        self._config_votes: Dict[str, tuple] = {}
+        self._config_fetch_at = 0.0
         # sampled request tracing (telemetry.RequestTracer), attached
         # after construction; the client stamps submit/retransmit/
         # accepted so a trace joins the replica-side phases end to end
@@ -130,6 +145,9 @@ class Client:
             try:
                 msg = Message.from_wire(raw)
             except ValueError:
+                continue
+            if isinstance(msg, ConfigReply):
+                self._on_config_reply(msg)
                 continue
             if not isinstance(msg, Reply) or msg.client_id != self.id:
                 continue
@@ -178,6 +196,12 @@ class Client:
         if fut is None or fut.done():
             return
         self.view_hint = max(self.view_hint, msg.view)
+        if msg.epoch > self.epoch:
+            # authenticated reply from a later committee epoch: our
+            # address book is stale — re-resolve instead of timing out
+            # against removed replicas (the reply itself still counts
+            # toward f+1 below; epoch is a hint, not part of matching)
+            self._maybe_refresh_config(msg.epoch)
         # f+1 matching is on the RESULT only (Castro-Liskov §2.4): honest
         # replicas may execute the same request in different views when a
         # failover re-proposes it, and their replies still agree on the
@@ -213,15 +237,12 @@ class Client:
                 backoff = min(0.25, self.request_timeout / 4)
                 loop.call_later(backoff, self._fire_mixed_retry, ts, raw)
 
-    def _fire_mixed_retry(self, ts: int, raw: bytes) -> None:
-        if ts not in self._waiters:
-            return
-        # hold the task reference (GC can cancel unreferenced tasks) and
-        # consume its exception (a transport closed during the backoff
-        # must not surface as 'exception was never retrieved')
-        task = asyncio.get_running_loop().create_task(
-            self.transport.broadcast(raw, self.cfg.replica_ids)
-        )
+    def _bg(self, coro) -> None:
+        """Launch a fire-and-forget send: hold the task reference (GC can
+        cancel unreferenced tasks) and consume its exception (a transport
+        closed during a backoff must not surface as 'exception was never
+        retrieved')."""
+        task = asyncio.get_running_loop().create_task(coro)
         self._bg_tasks.add(task)
 
         def _consume(t: asyncio.Task) -> None:
@@ -230,6 +251,93 @@ class Client:
                 t.exception()
 
         task.add_done_callback(_consume)
+
+    def _fire_mixed_retry(self, ts: int, raw: bytes) -> None:
+        if ts not in self._waiters:
+            return
+        self._bg(self.transport.broadcast(raw, self.cfg.replica_ids))
+
+    # -- committee re-resolution (ISSUE 7: live reconfiguration) ---------
+
+    def _maybe_refresh_config(self, epoch_hint: int) -> None:
+        """Fire one ConfigFetch round at the replicas we still know
+        (survivors answer — membership changes are bounded per epoch, so
+        f+1 of our current book are members of the new committee).
+        Rate-limited: every reply from the new epoch would otherwise
+        re-fire the round."""
+        now = time.monotonic()
+        if now - self._config_fetch_at < 0.5:
+            return
+        self._config_fetch_at = now
+        self.metrics["config_fetches"] += 1
+        cf = ConfigFetch(epoch=epoch_hint)
+        self.signer.sign_msg(cf)
+        self._bg(self.transport.broadcast(cf.to_wire(), self.cfg.replica_ids))
+
+    def _on_config_reply(self, msg: ConfigReply) -> None:
+        """Count signed configuration copies; adopt on f+1 matching
+        (epoch, config bytes) from DISTINCT known replicas. Verification
+        uses keys we already hold — a reply from an unknown sender (or a
+        forged config under a known key) never counts."""
+        if msg.sender not in self.cfg.replica_ids or msg.epoch <= self.epoch:
+            return
+        if self.cfg.verify_signatures:
+            pub = self.cfg.pubkey(msg.sender)
+            if pub is None or not msg.sig:
+                return
+            try:
+                sig = bytes.fromhex(msg.sig)
+            except ValueError:
+                return
+            ok = self.verifier.verify_batch(
+                [BatchItem(pubkey=pub, msg=msg.signing_payload(), sig=sig)]
+            )
+            if not ok[0]:
+                return
+        key = (msg.epoch, msg.config)
+        self._config_votes[msg.sender] = key
+        if (
+            sum(1 for v in self._config_votes.values() if v == key)
+            < self.cfg.weak_quorum
+        ):
+            return
+        import json
+
+        try:
+            new_cfg = config_from_doc(self.cfg, json.loads(msg.config))
+        except ValueError:
+            return
+        if new_cfg.epoch != msg.epoch:
+            return
+        self._adopt_config(new_cfg)
+
+    def _adopt_config(self, new_cfg: CommitteeConfig) -> None:
+        from .crypto import mac as mac_mod
+
+        self.cfg = new_cfg
+        self.epoch = new_cfg.epoch
+        self._config_votes.clear()
+        # reply MACs key on the replica set: rebuild for the new members
+        self._mac = mac_mod.MacBank(self._seed, new_cfg.kx_pubkeys)
+        if new_cfg.addrs:
+            # socket transports route by peer book — learn the added
+            # members' addresses or retransmits to a new primary that
+            # joined after our boot book was built silently vanish
+            from .transport.base import update_peer_book
+
+            update_peer_book(self.transport, new_cfg.addrs)
+        self.metrics["config_refreshes"] += 1
+        # chase the new committee NOW: in-flight requests head straight
+        # for the new primary instead of waiting out a timeout against a
+        # replica that may no longer exist
+        primary = self.cfg.primary(self.view_hint)
+        resent = 0
+        for ts, raw in list(self._inflight_raw.items()):
+            if ts in self._waiters:
+                self._bg(self.transport.send(primary, raw))
+                resent += 1
+        if resent:
+            self.metrics["config_retransmits"] += resent
 
     def retries_for_patience(self, patience: float) -> int:
         """Smallest retry count whose CUMULATIVE wait (backoff included,

@@ -38,6 +38,22 @@ class CommitteeConfig:
     # reply fast path (crypto/mac.py); pairs lacking either key fall
     # back to Ed25519-signed replies
     kx_pubkeys: Dict[str, bytes] = field(default_factory=dict)
+    # Live membership reconfiguration (ISSUE 7): the configuration
+    # epoch, bumped each time a committed Reconfig op activates at a
+    # checkpoint boundary. Epoch 0 is the boot committee. ``admin_ids``
+    # names the identities whose signed __reconfig__ operations are
+    # honored — empty means reconfiguration is disabled (every reconfig
+    # op executes as a denied no-op), the safe default.
+    epoch: int = 0
+    admin_ids: Tuple[str, ...] = ()
+    # Network address book (id -> (host, port)) for socket transports.
+    # Rides config_doc so a reconfiguration-added member is REACHABLE,
+    # not just named: epoch activation and client adoption push these
+    # into the transport peer maps (transport.base.update_peer_book).
+    # Empty for id-routed (local) committees, where reachability is not
+    # address-based. Deterministic: boot entries come from the shared
+    # deployment document, later ones from committed reconfig content.
+    addrs: Dict[str, Tuple[str, int]] = field(default_factory=dict)
 
     @property
     def n(self) -> int:
@@ -83,6 +99,127 @@ class CommitteeConfig:
         return self.bls_pubkeys.get(node_id)
 
 
+def config_doc(cfg: CommitteeConfig) -> Dict[str, object]:
+    """Deterministic JSON-ready description of the MEMBERSHIP state (the
+    part a reconfiguration changes): epoch, ordered replica ids, and the
+    key tables, hex-encoded with sorted ids. This block rides inside
+    every checkpoint snapshot (replica._checkpoint_snapshot) so a
+    state-transferred joiner restores the exact committee its peers run
+    — and it is what ConfigReply ships to stale clients."""
+    return {
+        "epoch": cfg.epoch,
+        "replica_ids": list(cfg.replica_ids),
+        "admin_ids": list(cfg.admin_ids),
+        "pubkeys": {k: v.hex() for k, v in sorted(cfg.pubkeys.items())},
+        "bls_pubkeys": {
+            k: v.hex() for k, v in sorted(cfg.bls_pubkeys.items())
+        },
+        "kx_pubkeys": {
+            k: v.hex() for k, v in sorted(cfg.kx_pubkeys.items())
+        },
+        "addrs": {
+            k: [v[0], v[1]] for k, v in sorted(cfg.addrs.items())
+        },
+    }
+
+
+def config_from_doc(base: CommitteeConfig, doc: Dict[str, object]) -> CommitteeConfig:
+    """Rebuild a CommitteeConfig from a config_doc, inheriting every
+    non-membership knob (timeouts, batching, qc_mode, ...) from
+    ``base``. Raises ValueError on a malformed doc — snapshot installs
+    must reject garbage atomically."""
+    import dataclasses
+
+    try:
+        ids = tuple(str(i) for i in doc["replica_ids"])
+        if not ids:
+            raise ValueError("empty replica_ids")
+        return dataclasses.replace(
+            base,
+            replica_ids=ids,
+            admin_ids=tuple(str(i) for i in doc.get("admin_ids", [])),
+            pubkeys={
+                str(k): bytes.fromhex(v)
+                for k, v in dict(doc["pubkeys"]).items()
+            },
+            bls_pubkeys={
+                str(k): bytes.fromhex(v)
+                for k, v in dict(doc.get("bls_pubkeys", {})).items()
+            },
+            kx_pubkeys={
+                str(k): bytes.fromhex(v)
+                for k, v in dict(doc.get("kx_pubkeys", {})).items()
+            },
+            addrs={
+                str(k): (str(v[0]), int(v[1]))
+                for k, v in dict(doc.get("addrs", {})).items()
+            },
+            epoch=int(doc["epoch"]),
+        )
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"bad config doc: {e}") from None
+
+
+def apply_reconfig(
+    cfg: CommitteeConfig,
+    add: Dict[str, Dict[str, str]],
+    remove,
+) -> CommitteeConfig:
+    """The committed membership change: remove ids, append new replicas
+    (sorted, after the survivors — rotation order must be identical on
+    every replica), bump the epoch. ``add`` maps new id -> {"pub": hex,
+    optional "bls": hex, optional "kx": hex, optional "addr":
+    "host:port" — required in practice for socket-transport committees,
+    or the new member is named but unreachable}. Raises ValueError when the
+    result would be degenerate (fewer than 4 replicas — f would hit 0
+    and the committee could no longer survive ANY fault) or malformed."""
+    import dataclasses
+
+    removes = set(remove)
+    unknown = removes - set(cfg.replica_ids)
+    if unknown:
+        raise ValueError(f"cannot remove non-members {sorted(unknown)}")
+    dup = set(add) & (set(cfg.replica_ids) - removes)
+    if dup:
+        raise ValueError(f"cannot add existing members {sorted(dup)}")
+    survivors = set(cfg.replica_ids) - removes
+    # subtract SURVIVORS, not current members: remove+add of the same id
+    # (key rotation) must re-add it, not silently drop the member
+    new_ids = tuple(i for i in cfg.replica_ids if i not in removes) + tuple(
+        sorted(set(add) - survivors)
+    )
+    if len(new_ids) < 4:
+        raise ValueError("resulting committee below n=4")
+    pubkeys = dict(cfg.pubkeys)
+    bls = dict(cfg.bls_pubkeys)
+    kx = dict(cfg.kx_pubkeys)
+    # removed members keep their address entry: retirees keep serving
+    # state-transfer chunks and config lookups until shut down
+    addrs = dict(cfg.addrs)
+    for rid, keys in add.items():
+        pubkeys[rid] = bytes.fromhex(keys["pub"])
+        if keys.get("bls"):
+            bls[rid] = bytes.fromhex(keys["bls"])
+        if keys.get("kx"):
+            kx[rid] = bytes.fromhex(keys["kx"])
+        if keys.get("addr"):
+            host, _, port = str(keys["addr"]).rpartition(":")
+            if not host:
+                raise ValueError(f"bad addr for {rid} (want host:port)")
+            addrs[rid] = (host, int(port))
+    if cfg.qc_mode and any(r not in bls for r in new_ids):
+        raise ValueError("qc_mode committee needs a bls key per member")
+    return dataclasses.replace(
+        cfg,
+        replica_ids=new_ids,
+        pubkeys=pubkeys,
+        bls_pubkeys=bls,
+        kx_pubkeys=kx,
+        addrs=addrs,
+        epoch=cfg.epoch + 1,
+    )
+
+
 @dataclass
 class KeyPair:
     seed: bytes
@@ -114,6 +251,11 @@ def make_test_committee(
     cfg = CommitteeConfig(
         replica_ids=ids,
         pubkeys={k: v.pub for k, v in keys.items()},
+        # test committees trust their generated clients as reconfig
+        # admins (production deployments name admin_ids explicitly)
+        admin_ids=overrides.pop(
+            "admin_ids", tuple(f"c{i}" for i in range(clients))
+        ),
         bls_pubkeys=overrides.pop("bls_pubkeys", bls_pubkeys),
         kx_pubkeys=overrides.pop(
             "kx_pubkeys",

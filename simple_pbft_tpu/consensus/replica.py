@@ -38,17 +38,25 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .. import spans
 from ..app import Application, KVStore
-from ..config import CommitteeConfig
+from ..config import (
+    CommitteeConfig,
+    apply_reconfig,
+    config_doc,
+    config_from_doc,
+)
 from ..crypto.coalesce import Overloaded
 from ..crypto.signer import Signer
 from ..crypto.verifier import BatchItem, Verifier, best_cpu_verifier
 from ..logutil import ReplicaStats
 from ..messages import (
+    DEFERRABLE,
     EMPTY_BLOCK_DIGEST,
     BlockFetch,
     BlockReply,
     Checkpoint,
     Commit,
+    ConfigFetch,
+    ConfigReply,
     Message,
     NewView,
     NewViewFetch,
@@ -58,12 +66,16 @@ from ..messages import (
     Reply,
     Request,
     SlotFetch,
+    StateChunkReply,
+    StateChunkRequest,
     StateRequest,
     StateResponse,
     ViewChange,
+    canonical_json,
 )
 from ..transport.base import Transport
 from . import qc as qc_mod
+from .statesync import StateSync
 from .state import ExecuteBlock, Instance, SendCommit, SendPrepare, Stage
 from .viewchange import (
     ViewChanger,
@@ -101,10 +113,18 @@ STALE_FOLD_INTERVALS = 16
 # as quorum-critical by default (phase votes, checkpoints, view-change
 # traffic, QCs, and the BlockReply/StateResponse repair payloads whose
 # absence is usually the overload's cause): an unlisted class is KEPT —
-# the safe polarity for consensus liveness.
-SHED_DEFERRABLE = (
-    Request, SlotFetch, BlockFetch, StateRequest, NewViewFetch,
-)
+# the safe polarity for consensus liveness. The class set itself lives
+# in messages.DEFERRABLE — one source shared with the TCP transport's
+# mid-write/drain policy so the two can't drift.
+SHED_DEFERRABLE = DEFERRABLE
+
+# Membership reconfiguration rides the ordinary request path as a
+# specially-prefixed operation (docs/SCENARIOS.md): deterministic
+# execution order for free (it IS a slot), admin authorization by the
+# request's own client signature, and activation deferred to the next
+# checkpoint boundary so every honest replica switches epochs at the
+# same watermark edge.
+RECONFIG_PREFIX = "__reconfig__ "
 
 
 class Replica:
@@ -124,6 +144,7 @@ class Replica:
         self.id = node_id
         self.cfg = cfg
         self.signer = Signer(node_id, seed)
+        self._seed = seed  # epoch changes rebuild the kx MacBank
         self.transport = transport
         self.app = app if app is not None else KVStore()
         self.verifier = verifier if verifier is not None else best_cpu_verifier()
@@ -255,6 +276,25 @@ class Replica:
         # the progress watchdog's stall age and pbft_top's CAGE column
         # read this instead of re-deriving progress from counter deltas
         self.last_commit_mono = 0.0
+        # chunked checkpoint state-transfer driver (consensus/statesync.py):
+        # both the requester side (watermark-gap / NEW-VIEW / cold-start
+        # rejoin catch-up) and the server side (peers' chunk requests)
+        self.statesync = StateSync(self)
+        # staged membership change: (activation_seq, new CommitteeConfig).
+        # Set by an executed __reconfig__ op; applied when execution
+        # reaches the checkpoint boundary activation_seq. Part of
+        # checkpoint state (rides every snapshot) — a state-transferred
+        # replica must inherit the staged change or its next boundary
+        # would diverge from the committee's.
+        self.pending_reconfig: Optional[Tuple[int, CommitteeConfig]] = None
+        # True once an epoch activated WITHOUT this replica: a retired
+        # member stops voting/proposing/replying but keeps serving
+        # state-transfer chunks and config lookups until shut down
+        self.retired = False
+        # byzantine seam (faults.StaleEpochVoter): a replica that REFUSES
+        # its retirement never sets `retired`, so its stale-epoch votes
+        # actually leave the process and hit the honest peers' role gate
+        self.refuse_retirement = False
 
     def _auth_reply(self, reply: Reply) -> None:
         """Authenticate a reply: per-client HMAC when BOTH ends publish kx
@@ -298,6 +338,7 @@ class Replica:
         lost anyway)."""
         self._running = False
         self.vc.cancel()
+        self.statesync.cancel()
         if self._ingest_task:
             self._ingest_task.cancel()
             try:
@@ -325,6 +366,7 @@ class Replica:
         mean by "crash the primary" — stop() is the orderly drain."""
         self._running = False
         self.vc.cancel()
+        self.statesync.cancel()
         for t in (self._ingest_task, self._task):
             if t is not None:
                 t.cancel()
@@ -689,7 +731,7 @@ class Replica:
             msg,
             (PrePrepare, Prepare, Commit, Checkpoint, ViewChange, NewView,
              QuorumCert, StateRequest, StateResponse, BlockFetch, BlockReply,
-             SlotFetch, NewViewFetch),
+             SlotFetch, NewViewFetch, StateChunkRequest, StateChunkReply),
         ):
             if msg.sender not in self._replica_set:
                 return []
@@ -854,6 +896,12 @@ class Replica:
             await self._on_state_request(msg)
         elif isinstance(msg, StateResponse):
             await self._on_state_response(msg)
+        elif isinstance(msg, StateChunkRequest):
+            await self.statesync.on_chunk_request(msg)
+        elif isinstance(msg, StateChunkReply):
+            await self.statesync.on_chunk_reply(msg)
+        elif isinstance(msg, ConfigFetch):
+            await self._on_config_fetch(msg)
         elif isinstance(msg, BlockFetch):
             await self._on_block_fetch(msg)
         elif isinstance(msg, BlockReply):
@@ -1007,6 +1055,16 @@ class Replica:
         if not self._in_window(self.next_seq):
             self.metrics["window_stall"] += 1
             return
+        if (
+            self.pending_reconfig is not None
+            and self.next_seq > self.pending_reconfig[0]
+        ):
+            # stop-sequence: a slot past a staged membership boundary
+            # belongs to the NEXT epoch — proposing it now would let the
+            # OLD committee's quorum decide a new-epoch slot. Hold until
+            # activation (one checkpoint interval at most).
+            self.metrics["reconfig_boundary_stall"] += 1
+            return
         block_reqs = self.pending_requests[: self.cfg.max_batch]
         self.pending_requests = self.pending_requests[self.cfg.max_batch :]
         seq = self.next_seq
@@ -1075,6 +1133,18 @@ class Replica:
             return
         if not self._in_window(msg.seq):
             self.metrics["out_of_window"] += 1
+            return
+        if (
+            isinstance(msg, PrePrepare)
+            and self.pending_reconfig is not None
+            and msg.seq > self.pending_reconfig[0]
+        ):
+            # stop-sequence (backup side): refuse to admit a proposal for
+            # a slot past the staged membership boundary — it would pin a
+            # digest and solicit votes under the OLD epoch's quorum. The
+            # primary retransmits after activation; votes for such slots
+            # merely buffer and are refiltered at the epoch switch.
+            self.metrics["preprepare_beyond_boundary"] += 1
             return
         inst = self._instance(msg.view, msg.seq)
         if isinstance(msg, PrePrepare):
@@ -1322,6 +1392,13 @@ class Replica:
             # NEW-VIEW (QC-mode commit execution may still reach here)
             self.metrics["vote_suppressed_in_vc"] += 1
             return
+        if self.retired:
+            # removed by a committed reconfiguration: an honest retiree
+            # goes silent on the consensus plane (peers would role-gate
+            # the votes out anyway — see faults.StaleEpochVoter for the
+            # byzantine replica that refuses to)
+            self.metrics["vote_suppressed_retired"] += 1
+            return
         vote = cls(view=act.view, seq=act.seq, digest=act.digest)
         if self.cfg.qc_mode:
             vote.bls_share = qc_mod.sign_share(
@@ -1399,7 +1476,13 @@ class Replica:
                     self.metrics["exec_replay_skipped"] += 1
                     await self._send_superseded(act.view, act.seq, req)
                     continue
-                result = self.app.apply(req.operation)
+                if req.operation.startswith(RECONFIG_PREFIX):
+                    # committed membership change: stage it; activation
+                    # waits for the next checkpoint boundary so every
+                    # honest replica switches epochs at the same edge
+                    result = self._execute_reconfig(act.seq, req)
+                else:
+                    result = self.app.apply(req.operation)
                 self.metrics["committed_requests"] += 1
                 # one hash decides sampling for BOTH execute and reply
                 trace_rid = (
@@ -1417,6 +1500,10 @@ class Replica:
                     client_id=req.client_id,
                     timestamp=req.timestamp,
                     result=result,
+                    # deterministic (epoch activation is a function of
+                    # executed history): a stale client sees a higher
+                    # epoch in any reply and re-resolves the committee
+                    epoch=self.cfg.epoch,
                 )
                 self.recent_replies.setdefault(req.client_id, {})[
                     req.timestamp
@@ -1431,7 +1518,11 @@ class Replica:
                 # hits the _on_request duplicate branch, where every
                 # replica signs-on-demand and resends the cached reply
                 # (the liveness fallback).
-                if (self._index - act.seq) % self.cfg.n < self.cfg.repliers:
+                if (
+                    not self.retired
+                    and (self._index - act.seq) % self.cfg.n
+                    < self.cfg.repliers
+                ):
                     self._auth_reply(reply)
                     self.metrics["replies_sent"] += 1
                     await self.transport.send(req.client_id, reply.to_wire())
@@ -1443,6 +1534,16 @@ class Replica:
                 # executed: the slot's trace binding is complete
                 self.tracer.release_slot(act.view, act.seq)
             if self.executed_seq % self.cfg.checkpoint_interval == 0:
+                if (
+                    self.pending_reconfig is not None
+                    and self.executed_seq >= self.pending_reconfig[0]
+                ):
+                    # the staged membership change activates AT the
+                    # boundary, BEFORE the checkpoint is cut, so the new
+                    # epoch's config rides this checkpoint's snapshot
+                    # and joiners state-transfer straight into it
+                    self._activate_epoch(self.pending_reconfig[1])
+                    self.pending_reconfig = None
                 await self._emit_checkpoint(self.executed_seq)
             self.vc.reset()  # commits are progress: the primary is alive
 
@@ -1466,9 +1567,202 @@ class Replica:
             client_id=req.client_id,
             timestamp=req.timestamp,
             superseded=1,
+            epoch=self.cfg.epoch,
         )
         self._auth_reply(reply)
         await self.transport.send(req.client_id, reply.to_wire())
+
+    # ------------------------------------------------------------------
+    # live membership reconfiguration (ISSUE 7 tentpole, pillar 3)
+    # ------------------------------------------------------------------
+
+    def _execute_reconfig(self, seq: int, req: Request) -> str:
+        """Execute a committed ``__reconfig__ {json}`` operation. Strictly
+        deterministic: every input is either committed block content or
+        checkpoint state, so every honest replica stages the identical
+        config with the identical activation seq (or returns the
+        identical denial string). Authorization is the request's own
+        client signature checked against cfg.admin_ids — already
+        batch-verified on admission like any client request."""
+        import json
+
+        if req.client_id not in self.cfg.admin_ids:
+            self.metrics["reconfig_denied"] += 1
+            return "reconfig-denied:not-admin"
+        if self.pending_reconfig is not None:
+            # one staged change at a time: a second change before the
+            # boundary would make the activation config ambiguous
+            self.metrics["reconfig_denied"] += 1
+            return "reconfig-denied:change-pending"
+        try:
+            spec = json.loads(req.operation[len(RECONFIG_PREFIX):])
+            add = {
+                str(k): {
+                    "pub": str(v["pub"]),
+                    "bls": str(v.get("bls", "")),
+                    "kx": str(v.get("kx", "")),
+                    "addr": str(v.get("addr", "")),
+                }
+                for k, v in dict(spec.get("add", {})).items()
+            }
+            remove = [str(x) for x in list(spec.get("remove", []))]
+            new_cfg = apply_reconfig(self.cfg, add, remove)
+        except (ValueError, TypeError, KeyError) as e:
+            self.metrics["reconfig_denied"] += 1
+            return f"reconfig-denied:{e}"
+        interval = self.cfg.checkpoint_interval
+        activate_at = (seq // interval + 1) * interval
+        self.pending_reconfig = (activate_at, new_cfg)
+        self.metrics["reconfig_staged"] += 1
+        return (
+            f"reconfig-staged:epoch={new_cfg.epoch}"
+            f":activate_at={activate_at}"
+        )
+
+    def _activate_epoch(self, new_cfg: CommitteeConfig) -> None:
+        """Switch committee epochs (at a checkpoint boundary, or inside a
+        snapshot install whose certified state already carries the new
+        config). Every honest replica switches at the same executed_seq,
+        so quorum math, primary rotation, and the consensus role-gate
+        change in lockstep. Seq-scoped consensus state (instances,
+        watermarks, stores) carries over untouched — sequence numbers
+        are epoch-global."""
+        from ..crypto import mac as mac_mod
+
+        old = self.cfg
+        self.cfg = new_cfg
+        self._replica_set = frozenset(new_cfg.replica_ids)
+        self.metrics["epoch"] = new_cfg.epoch
+        self.metrics["epochs_activated"] += 1
+        if self.id in new_cfg.replica_ids:
+            self._index = new_cfg.replica_ids.index(self.id)
+            self.retired = False
+        else:
+            # removed by the committee: go silent on the consensus plane
+            # but keep serving chunks/config (docs/SCENARIOS.md) — unless
+            # a byzantine injector made this replica refuse retirement,
+            # in which case it keeps voting and the peers' role gate is
+            # the defense under test
+            self.retired = not self.refuse_retirement
+        # the kx table changed membership: rebuild the per-client MAC bank
+        self._mac = mac_mod.MacBank(self._seed, new_cfg.kx_pubkeys)
+        if new_cfg.addrs:
+            # socket transports route by peer book — without this push a
+            # reconfiguration-added member is named but unreachable
+            from ..transport.base import update_peer_book
+
+            self.metrics["peer_book_updates"] += update_peer_book(
+                self.transport, new_cfg.addrs
+            )
+        # Register any NEW member keys with the verify seam WITHOUT
+        # reopening jit shapes: the device key bank is sized with
+        # headroom (initial_keys = population + 32, node.make_verifier),
+        # so a lookup fills a reserved row and the jit signature —
+        # (mode, window, batch, table cap) — is unchanged; buckets=[]
+        # compiles nothing. PR 3's warm_for_population contract, asserted
+        # as zero post_warm_compiles across the epoch boundary in tests.
+        new_keys = [
+            pk for rid, pk in new_cfg.pubkeys.items()
+            if old.pubkeys.get(rid) != pk
+        ]
+        warm = getattr(self.verifier, "warm", None)
+        if new_keys and callable(warm):
+            try:
+                warm(pubkeys=new_keys, buckets=[])
+            except Exception:
+                log.exception("%s: epoch key registration failed", self.id)
+        if self.auditor is not None:
+            # the audit plane must hold I1-I4 across the boundary: give
+            # it the new membership and an epoch marker in the ledger
+            self.auditor.on_epoch(new_cfg)
+        self._reconcile_boundary_instances(new_cfg)
+        log.info(
+            "%s: epoch %d -> %d (n=%d%s)",
+            self.id, old.epoch, new_cfg.epoch, new_cfg.n,
+            ", retired" if self.retired else "",
+        )
+
+    def _reconcile_boundary_instances(self, new_cfg: CommitteeConfig) -> None:
+        """Refit in-flight slots ABOVE the activation boundary to the new
+        epoch. The stop-sequence gates (_propose_if_ready /
+        _on_phase) keep such slots from forming while a change is
+        staged, but a replica learns of the staging only when it
+        EXECUTES the reconfig op — proposals pipelined ahead of its
+        execution frontier slip through with the OLD committee's quorum
+        threshold baked into their Instance. Left alone, a grown
+        committee (quorum 3 -> 5) would let f_new byzantine members plus
+        a stale threshold commit a new-epoch slot no honest new-epoch
+        quorum prepared. Execution order makes the repair airtight:
+        nothing above the boundary can have APPLIED before the boundary
+        itself, and activating runs before the boundary's checkpoint is
+        cut — so every straddler is still pending here and can be
+        refiltered (votes from non-members dropped, threshold rebased,
+        stale certificates discarded, unjustified stages walked back).
+        A walked-back slot re-forms under the new epoch via the
+        primary's retransmission or the next view change; its pinned
+        digest is kept, so the replica never votes two ways."""
+        boundary = self.executed_seq
+        members = self._replica_set
+        for (view, seq), inst in self.instances.items():
+            if seq <= boundary:
+                continue
+            inst.quorum = new_cfg.quorum
+            if inst.pre_prepare is None:
+                # no proposal pinned: repoint the slot at the new
+                # epoch's rotation so the right primary can fill it
+                inst.primary = new_cfg.primary(view)
+            for store in (inst.prepares, inst.commits):
+                for sender in [s for s in store if s not in members]:
+                    del store[sender]
+            if inst.digest is not None:
+                inst._recount_matching()
+            else:
+                inst._prep_matching = inst._com_matching = 0
+            if inst.qc_mode:
+                # certificates aggregated under the old epoch's signer
+                # set cannot decide a new-epoch slot
+                inst.prepare_qc = None
+                inst.commit_qc = None
+                still_prepared = still_committed = False
+            else:
+                still_prepared = inst.prepared()
+                still_committed = inst.committed()
+            if inst.stage == Stage.COMMITTED and not still_committed:
+                self.ready.pop(seq, None)  # queued but NOT applied (see
+                # the execution-order argument above)
+                inst.executed = False
+                inst.stage = (
+                    Stage.PREPARED if still_prepared else
+                    Stage.PRE_PREPARED if inst.pre_prepare is not None
+                    else Stage.IDLE
+                )
+                self.metrics["epoch_slots_downgraded"] += 1
+            elif inst.stage == Stage.PREPARED and not still_prepared:
+                inst.stage = (
+                    Stage.PRE_PREPARED if inst.pre_prepare is not None
+                    else Stage.IDLE
+                )
+                self.metrics["epoch_slots_downgraded"] += 1
+
+    async def _on_config_fetch(self, msg: ConfigFetch) -> None:
+        """Serve the committee configuration (a stale client's address-
+        book refresh after a reconfiguration). Cooldown-bounded per
+        sender; the reply is signed, and a client adopts only on f+1
+        matching copies from replicas it already knows — one lying
+        replica cannot steer a client into a fake committee."""
+        now = time.monotonic()
+        key = f"cfg:{msg.sender}"
+        if now - self._slot_fetch_served.get(key, 0.0) < self.SLOT_FETCH_COOLDOWN:
+            self.metrics["slot_fetch_throttled"] += 1
+            return
+        self._slot_fetch_served[key] = now
+        reply = ConfigReply(
+            epoch=self.cfg.epoch,
+            config=canonical_json(config_doc(self.cfg)).decode(),
+        )
+        self.signer.sign_msg(reply)
+        self.metrics["config_fetches_served"] += 1
+        await self.transport.send(msg.sender, reply.to_wire())
 
     # ------------------------------------------------------------------
     # checkpoints / watermarks
@@ -1484,6 +1778,20 @@ class Replica:
         return json.dumps(
             {
                 "app": self.app.snapshot(),
+                # the MEMBERSHIP is replicated state too (ISSUE 7): a
+                # state-transferred joiner must restore the exact epoch
+                # its peers run, and a staged-but-unactivated reconfig
+                # must survive the transfer or the joiner's next
+                # checkpoint boundary diverges from the committee's
+                "config": config_doc(self.cfg),
+                "pending_reconfig": (
+                    {
+                        "activate_at": self.pending_reconfig[0],
+                        "config": config_doc(self.pending_reconfig[1]),
+                    }
+                    if self.pending_reconfig is not None
+                    else None
+                ),
                 "watermark": self.client_watermark,
                 # declared completion floors gate the fold, so a
                 # state-transferred replica must restore them or its
@@ -1598,7 +1906,10 @@ class Replica:
             # checkpoints are compared against (audit I2)
             self.auditor.observe_message(cp)
         await self._on_checkpoint(cp)  # count our own
-        await self.transport.broadcast(cp.to_wire(), self.cfg.replica_ids)
+        if not self.retired:
+            # an honest retiree keeps folding locally but stops feeding
+            # the consensus plane (peers would role-gate the frame out)
+            await self.transport.broadcast(cp.to_wire(), self.cfg.replica_ids)
 
     async def ensure_checkpoint_qc(self) -> None:
         """QC mode: aggregate the stored 2f+1 checkpoint shares at the
@@ -1671,6 +1982,12 @@ class Replica:
         if seq <= self.stable_seq:
             return
         if seq > self.executed_seq:
+            # watermark gap: a checkpoint certificate exists beyond our
+            # execution frontier — the committee GC'd what we'd need to
+            # replay. Chunked, resumable, digest-verified transfer from
+            # the certifiers (consensus/statesync.py); the legacy
+            # single-frame StateRequest stays served for old peers but
+            # is no longer sent.
             if self.pending_sync is None or self.pending_sync[0] < seq:
                 self.pending_sync = (seq, digest)
                 self.metrics["state_sync_requests"] += 1
@@ -1680,11 +1997,7 @@ class Replica:
                         for r, cp in self.checkpoints[seq].items()
                         if cp.state_digest == digest
                     ]
-                targets = [r for r in certifiers if r != self.id]
-                sr = StateRequest(seq=seq)
-                self.signer.sign_msg(sr)
-                for peer in targets[: self.cfg.f + 1]:
-                    await self.transport.send(peer, sr.to_wire())
+                await self.statesync.begin(seq, digest, certifiers)
             return
         self._advance_stable(seq)
         await self._replay_vc_buffer()
@@ -2091,19 +2404,20 @@ class Replica:
         await self.transport.send(msg.sender, resp.to_wire())
 
     async def _on_state_response(self, msg: StateResponse) -> None:
+        """Legacy single-frame transfer answer (peers still serve the
+        protocol; we no longer request it — consensus/statesync.py owns
+        the requester side). Digest-verified against the certified
+        checkpoint, then installed through the shared path."""
         if self.pending_sync is None:
             return
         seq, digest = self.pending_sync
         if msg.seq != seq:
             return
         if seq <= self.executed_seq:
-            # we outran the sync while the response was in flight (hole
-            # repair raced state transfer): applying it now would REGRESS
-            # executed_seq below blocks already popped from `ready` —
-            # leaving execution wedged at the checkpoint forever (and
-            # double-applying the app state). Measured under 2% chaos at
-            # n=64: replicas frozen at exec == checkpoint seq with later
-            # instances marked executed but never applied.
+            # obsolete BEFORE hashing: the snapshot is attacker-sized and
+            # SHA-256 of a multi-MB frame on the event loop is the cost
+            # the old ordering existed to avoid (install_snapshot keeps
+            # the same guard for the chunked path)
             self.pending_sync = None
             self.metrics["state_sync_obsolete"] += 1
             return
@@ -2112,13 +2426,34 @@ class Replica:
         if snapshot_digest(msg.snapshot) != digest:
             self.metrics["bad_snapshot"] += 1
             return  # responder lied; certificate digest is the authority
+        if await self.install_snapshot(seq, digest, msg.snapshot):
+            self.statesync.cancel()  # a whole-frame answer beat the chunks
+
+    async def install_snapshot(
+        self, seq: int, digest: str, snapshot: str
+    ) -> bool:
+        """Install a DIGEST-VERIFIED checkpoint snapshot (both transfer
+        paths land here: the chunked statesync assembly and the legacy
+        StateResponse). Returns True when installed.
+
+        Obsolescence guard: if we outran the sync while the transfer was
+        in flight (hole repair raced state transfer), applying it now
+        would REGRESS executed_seq below blocks already popped from
+        `ready` — leaving execution wedged at the checkpoint forever
+        (and double-applying the app state). Measured under 2% chaos at
+        n=64: replicas frozen at exec == checkpoint seq with later
+        instances marked executed but never applied."""
+        if seq <= self.executed_seq:
+            self.pending_sync = None
+            self.metrics["state_sync_obsolete"] += 1
+            return False
         try:
             import json
 
             # parse EVERYTHING into temporaries first: a half-applied
             # snapshot (app restored, reply map rejected) would leave the
             # replica permanently diverged from the certified digest
-            payload = json.loads(msg.snapshot)
+            payload = json.loads(snapshot)
             wm = payload["watermark"]
             acks = payload.get("ack", {})
             replies = payload["replies"]
@@ -2143,22 +2478,45 @@ class Replica:
                     self.signer.sign_msg(rep)  # we vouch for the result
                     inner[int(ts)] = rep
                 restored[str(c)] = inner
+            # membership state (ISSUE 7): snapshots cut since the
+            # reconfig plane landed carry the committee config and any
+            # staged-but-unactivated change; older/foreign snapshots
+            # (no "config" key) keep the boot config
+            new_cfg = None
+            cfg_doc = payload.get("config")
+            if cfg_doc is not None:
+                new_cfg = config_from_doc(self.cfg, cfg_doc)
+            new_pending = None
+            pend = payload.get("pending_reconfig")
+            if pend:
+                new_pending = (
+                    int(pend["activate_at"]),
+                    config_from_doc(self.cfg, pend["config"]),
+                )
             self.app.restore(app_snap)  # last: commit point
             self.client_watermark = new_wm
             self.client_ack = new_ack
             self.recent_replies = restored
         except (ValueError, TypeError, KeyError):
             self.metrics["bad_snapshot"] += 1
-            return
+            return False
+        if new_cfg is not None and new_cfg.epoch > self.cfg.epoch:
+            # the certified state already lives in a later epoch: adopt
+            # it now — quorum math below (certifier widening, probes)
+            # must use the membership the committee actually runs
+            self._activate_epoch(new_cfg)
+        if new_cfg is not None:
+            self.pending_reconfig = new_pending
         self.pending_sync = None
         self.executed_seq = seq
-        self.snapshots[seq] = msg.snapshot
+        self.snapshots[seq] = snapshot
         self.checkpoint_digests[seq] = digest
         self.ready = {s: a for s, a in self.ready.items() if s > seq}
         self.metrics["state_syncs"] += 1
         self._advance_stable(seq)
         await self._execute_ready()  # buffered blocks beyond the snapshot
         await self._replay_vc_buffer()
+        return True
 
     def _advance_stable(self, seq: int) -> None:
         if seq <= self.stable_seq:

@@ -98,6 +98,10 @@ def generate(
         replica_ids=tuple(sorted(doc["replicas"])),
         pubkeys=pubkeys,
         kx_pubkeys=kx_pubkeys,
+        # boot address book rides the config (and thus every checkpoint
+        # snapshot): joiners and reconfigurations inherit reachability,
+        # not just membership (transport.base.update_peer_book)
+        addrs=dict(addresses),
         **{k: v for k, v in options.items() if k in _OPTION_FIELDS},
     )
     return Deployment(cfg=cfg, addresses=addresses)
@@ -134,6 +138,7 @@ def load(path: str) -> Deployment:
         replica_ids=tuple(sorted(replicas)),
         pubkeys=pubkeys,
         kx_pubkeys=kx_pubkeys,
+        addrs=dict(addresses),
         **{k: v for k, v in options.items() if k in _OPTION_FIELDS},
     )
     return Deployment(cfg=cfg, addresses=addresses)

@@ -9,34 +9,18 @@ event list on every host, every run — so a wedge reproduces, an A/B pair
 really differs only in the axis under test, and a regression test can
 assert behavior under the EXACT schedule that once wedged.
 
-Fault kinds:
-
-- ``crash``        — crash-stop a replica (the named one, or whoever is
-                     primary of the highest live view at fire time).
-- ``drop_window``  — raise the network's iid drop rate to ``magnitude``
-                     for ``duration`` seconds, then restore.
-- ``delay_window`` — uniform per-message delay up to ``magnitude``
-                     seconds for ``duration`` seconds, then restore.
-- ``slow_verifier``— arm a SlowVerifier wrapper: every batch pays
-                     ``magnitude`` extra seconds for ``duration``.
-- ``stall_device`` — arm a StallableDevice wrapper: device finishers
-                     block for ``duration`` seconds (or until released).
-                     This is the fault the VerifyService dispatch-
-                     deadline watchdog exists for — see crypto/coalesce.
-- ``equivocate``   — wrap the target's transport in EquivocatingPrimary:
-                     its pre-prepares FORK — half the committee gets the
-                     real block, the other half a validly-signed variant
-                     with a different digest (disjoint recipient halves,
-                     so no single honest node sees both). The detection
-                     target of the audit plane (docs/AUDIT.md).
-- ``fork_checkpoint`` — wrap the target in ForkingCheckpointer: its
-                     outbound checkpoints carry a wrong state digest,
-                     validly re-signed — the checkpoint-divergence
-                     detection target.
+The fault kinds are defined in ``KIND_REGISTRY`` below — the SINGLE
+source of truth the docstrings, the ``--fault-schedule`` parse errors,
+and ``KINDS`` are all generated from (a kind added to the registry can
+never again drift undocumented). Call ``kind_table()`` for the current
+table; it is appended to this module's and FaultSchedule's docstrings
+at import.
 
 The injector drives a LocalCommittee (transport/local.py); the wrappers
 slot into any verifier seam. Real-process deployments get the same
-schedule shape through bench_consensus.py's --fault-schedule flag.
+schedule shape through bench_consensus.py's --fault-schedule flag, and
+WAN link shaping additionally through node.py's --wan-profile flag
+(docs/SCENARIOS.md).
 """
 
 from __future__ import annotations
@@ -45,16 +29,78 @@ import asyncio
 import random
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import ClassVar, Dict, List, Optional, Sequence, Set, Tuple
 
 from .crypto.signer import Signer
 from .messages import Checkpoint, Message, PrePrepare, sha256_hex
 
-KINDS = (
-    "crash", "drop_window", "delay_window", "slow_verifier", "stall_device",
-    "equivocate", "fork_checkpoint",
-)
+# The authoritative fault-kind registry: kind -> one-line description.
+# EVERYTHING that names the kind set (module/class docstrings, parse
+# error messages, KINDS) derives from this dict — add new kinds HERE.
+KIND_REGISTRY: Dict[str, str] = {
+    "crash": (
+        "crash-stop a replica (the named one, or whoever is primary of "
+        "the highest live view at fire time)"
+    ),
+    "drop_window": (
+        "raise the network's iid drop rate to `magnitude` for "
+        "`duration` seconds, then restore"
+    ),
+    "delay_window": (
+        "uniform per-message delay up to `magnitude` seconds for "
+        "`duration` seconds, then restore"
+    ),
+    "slow_verifier": (
+        "arm a SlowVerifier wrapper: every batch pays `magnitude` extra "
+        "seconds for `duration`"
+    ),
+    "stall_device": (
+        "arm a StallableDevice wrapper: device finishers block for "
+        "`duration` seconds (the VerifyService dispatch-deadline "
+        "watchdog's target — see crypto/coalesce)"
+    ),
+    "equivocate": (
+        "wrap the target in EquivocatingPrimary: pre-prepares FORK to "
+        "disjoint committee halves, validly signed (docs/AUDIT.md)"
+    ),
+    "fork_checkpoint": (
+        "wrap the target in ForkingCheckpointer: outbound checkpoints "
+        "carry a wrong, validly re-signed state digest"
+    ),
+    "partition": (
+        "cut links per `spec` 'SRCS>DSTS' (asymmetric) or 'SRCS<>DSTS' "
+        "(symmetric), groups |-separated, '*' = all replicas; heals "
+        "after `duration` seconds when duration > 0 (ShapedTransport)"
+    ),
+    "heal": "heal every open partition on every shaped transport",
+    "shape": (
+        "apply the named WAN profile in `spec` (see WAN_PROFILES: "
+        "wan3dc, lossy) to every replica's links for `duration` "
+        "seconds (0 = rest of the run)"
+    ),
+    "stale_epoch": (
+        "arm a StaleEpochVoter on the target: a replica removed by a "
+        "reconfiguration that keeps voting in the old committee "
+        "(honest nodes must role-gate it out, docs/SCENARIOS.md)"
+    ),
+    "forge_statesync": (
+        "arm a ForgedSnapshotServer on the target: state-transfer "
+        "chunks it serves are corrupted — a joiner must detect the "
+        "digest mismatch and re-fetch from another peer"
+    ),
+}
+
+KINDS = tuple(KIND_REGISTRY)
+
+
+def kind_table() -> str:
+    """The fault-kind table, regenerated from KIND_REGISTRY."""
+    width = max(len(k) for k in KIND_REGISTRY)
+    return "\n".join(
+        f"- {k.ljust(width)} : {desc}" for k, desc in KIND_REGISTRY.items()
+    )
 
 
 @dataclass(frozen=True)
@@ -66,15 +112,21 @@ class FaultEvent:
     target: str = ""  # replica id; "" = current primary at fire time
     duration: float = 0.0
     magnitude: float = 0.0
+    # kind-specific payload: partition group spec ("r0|r1>r2|r3"),
+    # WAN profile name for `shape` ("wan3dc") — empty for other kinds
+    spec: str = ""
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "t": round(self.t, 3),
             "kind": self.kind,
             "target": self.target,
             "duration": round(self.duration, 3),
             "magnitude": round(self.magnitude, 4),
         }
+        if self.spec:
+            d["spec"] = self.spec
+        return d
 
 
 @dataclass(frozen=True)
@@ -97,11 +149,16 @@ class FaultSchedule:
         device_stalls: int = 0,
         equivocators: int = 0,
         checkpoint_forkers: int = 0,
+        partition_windows: int = 0,
+        wan: str = "",
+        stale_epoch_voters: int = 0,
+        statesync_forgers: int = 0,
         replica_ids: Sequence[str] = (),
         drop_rate: float = 0.02,
         delay_s: float = 0.03,
         slow_s: float = 0.05,
         stall_s: float = 5.0,
+        extra_events: Sequence["FaultEvent"] = (),
     ) -> "FaultSchedule":
         """Deterministic schedule over ``horizon`` seconds. Same
         arguments -> byte-identical schedule, on any host (the RNG is a
@@ -159,40 +216,185 @@ class FaultSchedule:
             )
             events.append(FaultEvent(t=t, kind="fork_checkpoint",
                                      target=target))
-        events.sort(key=lambda e: (e.t, e.kind, e.target))
+        for t in times(partition_windows):
+            # deterministic random split: a minority group loses its
+            # links TO the majority (asymmetric — it still hears them)
+            # half the time, both directions otherwise; always heals
+            # before the drain window (duration bounded by the window
+            # rule the other kinds use)
+            ids = list(replica_ids)
+            if len(ids) < 2:
+                continue
+            rng.shuffle(ids)
+            cut = max(1, len(ids) // 3)
+            a, b = ids[:cut], ids[cut:]
+            arrow = ">" if rng.random() < 0.5 else "<>"
+            events.append(FaultEvent(
+                t=t, kind="partition",
+                # clamp the floor: on short horizons uniform(0.5, 0.15h)
+                # would INVERT its bounds and deal durations past the cap
+                # (and potentially past the horizon into the drain)
+                duration=rng.uniform(
+                    min(0.5, 0.15 * horizon), 0.15 * horizon
+                ),
+                spec=f"{'|'.join(a)}{arrow}{'|'.join(b)}",
+            ))
+        if wan:
+            if wan not in WAN_PROFILES:
+                raise ValueError(
+                    f"unknown WAN profile {wan!r} "
+                    f"(known: {sorted(WAN_PROFILES)})"
+                )
+            # profile applies from t=0 for the whole run: WAN shaping is
+            # an environment, not a transient fault
+            events.append(FaultEvent(t=0.0, kind="shape", spec=wan))
+        for t in times(stale_epoch_voters):
+            target = rng.choice(list(replica_ids)) if replica_ids else ""
+            events.append(FaultEvent(t=t, kind="stale_epoch",
+                                     target=target))
+        for t in times(statesync_forgers):
+            target = rng.choice(list(replica_ids)) if replica_ids else ""
+            events.append(FaultEvent(t=t, kind="forge_statesync",
+                                     target=target))
+        events.extend(extra_events)
+        events.sort(key=lambda e: (e.t, e.kind, e.target, e.spec))
         return cls(seed=seed, horizon=horizon, events=tuple(events))
+
+    # --fault-schedule spec keys (regenerated into parse errors so new
+    # keys can't drift undocumented): scalar keys take one value (last
+    # wins), event keys may REPEAT (each occurrence adds an event) and
+    # may also hold several ';'-separated entries in one value.
+    SCALAR_PARSE_KEYS: ClassVar[Dict[str, str]] = {
+        "seed": "RNG seed (default 42)",
+        "crashes": "count of crash events",
+        "drops": "count of drop_window events",
+        "delays": "count of delay_window events",
+        "slow": "count of slow_verifier windows",
+        "stalls": "count of stall_device events",
+        "equiv": "count of equivocate events",
+        "forkckpt": "count of fork_checkpoint events",
+        "partitions": "count of GENERATED random partition windows",
+        "stale": "count of stale_epoch events",
+        "forgesync": "count of forge_statesync events",
+        "wan": "WAN profile name applied at t=0 (wan3dc, lossy, ...)",
+        "stall_s": "stall_device duration seconds",
+        "drop_rate": "drop_window base rate",
+        "delay_s": "delay_window base delay seconds",
+        "slow_s": "slow_verifier base delay seconds",
+    }
+    EVENT_PARSE_KEYS: ClassVar[Dict[str, str]] = {
+        "partition": (
+            "T:SRCS>DSTS[:DUR] or T:SRCS<>DSTS[:DUR] — explicit "
+            "partition at T seconds, groups |-separated, '*'=all; "
+            "DUR>0 auto-heals"
+        ),
+        "heal": "T — heal every open partition at T seconds",
+        "shape": "NAME or T:NAME[:DUR] — apply a WAN profile",
+    }
 
     @classmethod
     def parse(cls, spec: str, horizon: float,
               replica_ids: Sequence[str] = ()) -> "FaultSchedule":
         """Build from a CLI spec like
-        ``seed=42,crashes=3,drops=1,delays=1,slow=0,stalls=1,equiv=1,
-        forkckpt=1`` — the bench_consensus --fault-schedule format.
-        Raises ValueError on unknown keys (a typo must not silently
-        mean 'no faults')."""
-        raw = dict(kv.split("=", 1) for kv in spec.split(",") if kv)
-        known = {"seed", "crashes", "drops", "delays", "slow", "stalls",
-                 "stall_s", "drop_rate", "delay_s", "slow_s",
-                 "equiv", "forkckpt"}
-        bad = set(raw) - known
-        if bad:
-            raise ValueError(f"unknown fault-schedule keys {sorted(bad)}")
+        ``seed=42,crashes=3,drops=1,stalls=1,equiv=1,forkckpt=1,
+        partition=2.0:r0|r1<>r2|r3:1.5,heal=5.0,shape=wan3dc`` — the
+        bench_consensus --fault-schedule format. Raises ValueError on
+        unknown keys (a typo must not silently mean 'no faults'); the
+        error names every known key and the kind table, both generated
+        from the registries."""
+        scalars: Dict[str, str] = {}
+        extra: List[FaultEvent] = []
+        for kv in spec.split(","):
+            if not kv:
+                continue
+            if "=" not in kv:
+                raise ValueError(
+                    f"malformed fault-schedule entry {kv!r} (want key=value)"
+                )
+            key, val = kv.split("=", 1)
+            if key in cls.SCALAR_PARSE_KEYS:
+                scalars[key] = val
+            elif key in cls.EVENT_PARSE_KEYS:
+                for one in val.split(";"):
+                    if one:
+                        extra.append(cls._parse_event(key, one, replica_ids))
+            else:
+                known = sorted(cls.SCALAR_PARSE_KEYS) + sorted(
+                    cls.EVENT_PARSE_KEYS
+                )
+                raise ValueError(
+                    f"unknown fault-schedule key {key!r}; known keys: "
+                    f"{known}\nfault kinds:\n{kind_table()}"
+                )
         return cls.generate(
-            seed=int(raw.get("seed", 42)),
+            seed=int(scalars.get("seed", 42)),
             horizon=horizon,
-            crashes=int(raw.get("crashes", 0)),
-            drop_windows=int(raw.get("drops", 0)),
-            delay_windows=int(raw.get("delays", 0)),
-            slow_verifier_windows=int(raw.get("slow", 0)),
-            device_stalls=int(raw.get("stalls", 0)),
-            equivocators=int(raw.get("equiv", 0)),
-            checkpoint_forkers=int(raw.get("forkckpt", 0)),
+            crashes=int(scalars.get("crashes", 0)),
+            drop_windows=int(scalars.get("drops", 0)),
+            delay_windows=int(scalars.get("delays", 0)),
+            slow_verifier_windows=int(scalars.get("slow", 0)),
+            device_stalls=int(scalars.get("stalls", 0)),
+            equivocators=int(scalars.get("equiv", 0)),
+            checkpoint_forkers=int(scalars.get("forkckpt", 0)),
+            partition_windows=int(scalars.get("partitions", 0)),
+            wan=scalars.get("wan", ""),
+            stale_epoch_voters=int(scalars.get("stale", 0)),
+            statesync_forgers=int(scalars.get("forgesync", 0)),
             replica_ids=replica_ids,
-            drop_rate=float(raw.get("drop_rate", 0.02)),
-            delay_s=float(raw.get("delay_s", 0.03)),
-            slow_s=float(raw.get("slow_s", 0.05)),
-            stall_s=float(raw.get("stall_s", 5.0)),
+            drop_rate=float(scalars.get("drop_rate", 0.02)),
+            delay_s=float(scalars.get("delay_s", 0.03)),
+            slow_s=float(scalars.get("slow_s", 0.05)),
+            stall_s=float(scalars.get("stall_s", 5.0)),
+            extra_events=extra,
         )
+
+    @classmethod
+    def _parse_event(cls, key: str, val: str,
+                     replica_ids: Sequence[str]) -> FaultEvent:
+        """One explicit event entry (see EVENT_PARSE_KEYS grammar)."""
+        if key == "heal":
+            try:
+                return FaultEvent(t=float(val), kind="heal")
+            except ValueError:
+                raise ValueError(f"heal= wants a time, got {val!r}") from None
+        if key == "shape":
+            parts = val.split(":")
+            if len(parts) == 1:
+                t, name, dur = 0.0, parts[0], 0.0
+            else:
+                # multi-part MUST be T:NAME[:DUR] — a non-numeric first
+                # field (e.g. 'shape=lossy:5') is a malformed spec, and a
+                # typo must not silently mean different faults
+                try:
+                    t = float(parts[0])
+                    dur = float(parts[2]) if len(parts) > 2 else 0.0
+                except ValueError:
+                    raise ValueError(
+                        f"shape= wants NAME or T:NAME[:DUR], got {val!r}"
+                    ) from None
+                name = parts[1]
+            if name not in WAN_PROFILES:
+                raise ValueError(
+                    f"shape= wants a WAN profile "
+                    f"(known: {sorted(WAN_PROFILES)}), got {val!r}"
+                )
+            return FaultEvent(t=t, kind="shape", spec=name, duration=dur)
+        # partition: T:SRCS>DSTS[:DUR]
+        parts = val.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                f"partition= wants T:SRCS>DSTS[:DUR], got {val!r}"
+            )
+        try:
+            t = float(parts[0])
+            dur = float(parts[2]) if len(parts) > 2 else 0.0
+        except ValueError:
+            raise ValueError(
+                f"partition= wants numeric T/DUR, got {val!r}"
+            ) from None
+        parse_partition_spec(parts[1], replica_ids)  # validate now
+        return FaultEvent(t=t, kind="partition", spec=parts[1],
+                          duration=dur)
 
     def summary(self) -> dict:
         """Bench-record form: enough to regenerate AND to eyeball."""
@@ -205,6 +407,278 @@ class FaultSchedule:
             "counts": kinds,
             "events": [e.to_dict() for e in self.events],
         }
+
+
+# ---------------------------------------------------------------------------
+# WAN link shaping (ISSUE 7 tentpole): a transport wrapper that imposes
+# per-link latency/jitter/bandwidth/loss and asymmetric partitions. It
+# composes over ANY Transport (local endpoint, tcp, grpc) because it
+# shapes at the SEND seam — each node shapes its own outbound links, so
+# an asymmetric partition A->B is simply A's wrapper cutting dest B
+# while B keeps sending to A.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LinkShape:
+    """One directed link's character. delay/jitter are seconds added per
+    frame; ``loss`` is an iid drop probability; ``bw_bytes_per_s`` > 0
+    serializes frames through a token-bucket link (a 1 MB NEW-VIEW on a
+    1 MB/s link takes a second — the failover shape WAN runs expose)."""
+
+    delay_s: float = 0.0
+    jitter_s: float = 0.0
+    loss: float = 0.0
+    bw_bytes_per_s: float = 0.0  # 0 = unlimited
+
+
+def _node_seed(node_id: str) -> int:
+    """Stable per-node RNG salt. NOT ``hash(str)`` — that is salted per
+    process (PYTHONHASHSEED), which would break the module's core
+    contract: the same seed must replay the identical jitter/loss stream
+    on any host, any run."""
+    return zlib.crc32(node_id.encode()) & 0xFFFF
+
+
+#: Named WAN profiles. A profile is a function (ids, seed) -> per-src
+#: per-dst LinkShape maps; registered here so schedules/CLI flags can
+#: name them (`shape=wan3dc`, node.py --wan-profile lossy).
+WAN_PROFILES: Dict[str, object] = {}
+
+
+def _profile(name):
+    def reg(fn):
+        WAN_PROFILES[name] = fn
+        return fn
+
+    return reg
+
+
+@_profile("wan3dc")
+def _wan3dc(ids: Sequence[str], seed: int = 0) -> Dict[str, Dict[str, LinkShape]]:
+    """Three datacenters, nodes assigned round-robin: intra-DC links are
+    fast LAN (~0.3 ms), inter-DC links pay ~12 ms +/- jitter with a
+    trickle of loss — the classic geo-replicated committee."""
+    dc = {rid: i % 3 for i, rid in enumerate(ids)}
+    lan = LinkShape(delay_s=0.0003, jitter_s=0.0001)
+    wan = LinkShape(delay_s=0.012, jitter_s=0.003, loss=0.002)
+    return {
+        src: {
+            dst: (lan if dc[src] == dc[dst] else wan)
+            for dst in ids if dst != src
+        }
+        for src in ids
+    }
+
+
+@_profile("lossy")
+def _lossy(ids: Sequence[str], seed: int = 0) -> Dict[str, Dict[str, LinkShape]]:
+    """Every link pays a few ms and drops 5% of frames iid — the
+    retransmission-path workout (PBFT must commit through it)."""
+    link = LinkShape(delay_s=0.002, jitter_s=0.002, loss=0.05)
+    return {src: {dst: link for dst in ids if dst != src} for src in ids}
+
+
+def parse_partition_spec(
+    spec: str, ids: Sequence[str] = ()
+) -> Tuple[Set[str], Set[str], bool]:
+    """``SRCS>DSTS`` (asymmetric: srcs stop reaching dsts) or
+    ``SRCS<>DSTS`` (symmetric). Groups are ``|``-separated ids; ``*``
+    means every known replica. Returns (srcs, dsts, symmetric)."""
+    sym = "<>" in spec
+    sep = "<>" if sym else ">"
+    if sep not in spec:
+        raise ValueError(
+            f"partition spec {spec!r} wants 'SRCS>DSTS' or 'SRCS<>DSTS'"
+        )
+    left, right = spec.split(sep, 1)
+
+    def group(s: str) -> Set[str]:
+        if s == "*":
+            return set(ids)
+        members = {m for m in s.split("|") if m}
+        if not members:
+            raise ValueError(f"empty group in partition spec {spec!r}")
+        return members
+
+    return group(left), group(right), sym
+
+
+class ShapedTransport:
+    """Wraps any Transport; outbound frames pay the configured link
+    shape (latency + jitter + bandwidth serialization) and may be
+    dropped (loss, partitions). Inbound is passthrough — shaping both
+    directions of a pair means wrapping both endpoints, which is what
+    the injector and committee helpers do.
+
+    Deterministic per node: the jitter/loss RNG is seeded, so a seeded
+    schedule over a seeded committee replays the identical delivery
+    pattern. Per-link FIFO order is preserved (frames queue behind the
+    link's bandwidth serialization point, like a real socket)."""
+
+    def __init__(
+        self,
+        inner,
+        shapes: Optional[Dict[str, LinkShape]] = None,
+        default: Optional[LinkShape] = None,
+        seed: int = 0,
+        profile: str = "",
+    ) -> None:
+        self._inner = inner
+        self.node_id = inner.node_id
+        self.shapes: Dict[str, LinkShape] = dict(shapes or {})
+        self.default = default or LinkShape()
+        self.profile = profile
+        self.cut_to: Set[str] = set()  # outbound-blocked destinations
+        self.rng = random.Random(seed)
+        self._link_free: Dict[str, float] = {}  # bw serialization point
+        self._link_last: Dict[str, float] = {}  # FIFO clamp: last delivery
+        self._bg: Set[asyncio.Task] = set()
+        self.shaping_metrics: Dict[str, int] = {
+            "shaped_sent": 0,
+            "shaped_delayed": 0,
+            "shaped_lost": 0,
+            "partition_dropped": 0,
+        }
+
+    # -- shaping controls --------------------------------------------------
+
+    @classmethod
+    def wrap_profile(
+        cls, inner, profile: str, ids: Sequence[str], seed: int = 0
+    ) -> "ShapedTransport":
+        """Wrap ``inner`` with the named WAN profile's outbound links
+        for this node (node.py --wan-profile path)."""
+        maps = WAN_PROFILES[profile](ids, seed)
+        return cls(
+            inner,
+            shapes=maps.get(inner.node_id, {}),
+            seed=seed ^ _node_seed(inner.node_id),
+            profile=profile,
+        )
+
+    def apply_profile(self, profile: str, ids: Sequence[str],
+                      seed: int = 0) -> None:
+        maps = WAN_PROFILES[profile](ids, seed)
+        self.shapes = dict(maps.get(self.node_id, {}))
+        self.profile = profile
+
+    def clear_shaping(self) -> None:
+        self.shapes = {}
+        self.default = LinkShape()
+        self.profile = ""
+
+    def partition(self, dests) -> None:
+        self.cut_to |= {d for d in dests if d != self.node_id}
+
+    def heal(self, dests=None) -> None:
+        if dests is None:
+            self.cut_to.clear()
+        else:
+            self.cut_to -= set(dests)
+
+    # -- telemetry ---------------------------------------------------------
+
+    @property
+    def metrics(self) -> Dict[str, int]:
+        # one merged counter surface so NodeTelemetry's transport block
+        # shows wire AND shaping counters for a shaped node
+        merged = dict(getattr(self._inner, "metrics", {}) or {})
+        merged.update(self.shaping_metrics)
+        return merged
+
+    def shaping_snapshot(self) -> Dict[str, object]:
+        """The NET state pbft_top renders: active profile, open cuts,
+        shaped-link count, loss/partition drop counters."""
+        return {
+            "profile": self.profile,
+            "cut_to": sorted(self.cut_to),
+            "shaped_links": len(self.shapes),
+            **self.shaping_metrics,
+        }
+
+    # -- Transport interface ----------------------------------------------
+
+    def _shape_for(self, dest: str) -> LinkShape:
+        return self.shapes.get(dest, self.default)
+
+    async def send(self, dest: str, raw: bytes) -> None:
+        if dest in self.cut_to:
+            self.shaping_metrics["partition_dropped"] += 1
+            return
+        sh = self._shape_for(dest)
+        if sh.loss and self.rng.random() < sh.loss:
+            self.shaping_metrics["shaped_lost"] += 1
+            return
+        delay = sh.delay_s
+        if sh.jitter_s:
+            delay += sh.jitter_s * self.rng.random()
+        loop = asyncio.get_running_loop()
+        now = loop.time()  # the clock call_at schedules against
+        if sh.bw_bytes_per_s > 0:
+            # serialize through the link: frames queue behind the byte
+            # clock, preserving per-link FIFO under bandwidth pressure
+            start = max(now, self._link_free.get(dest, 0.0))
+            tx = len(raw) / sh.bw_bytes_per_s
+            self._link_free[dest] = start + tx
+            delay += (start - now) + tx
+        target = now + delay
+        last = self._link_last.get(dest, 0.0)
+        if target <= last:
+            # jitter must not reorder the link: a TCP byte stream never
+            # delivers frame B before an earlier frame A. STRICTLY after
+            # the link's previous delivery — equal timer deadlines pop
+            # in heap order, not send order
+            target = last + 1e-6
+        self._link_last[dest] = target
+        self.shaping_metrics["shaped_sent"] += 1
+        if target - now <= 0:
+            await self._inner.send(dest, raw)
+            return
+        self.shaping_metrics["shaped_delayed"] += 1
+        loop.call_at(target, self._deliver_later, dest, raw)
+
+    def _deliver_later(self, dest: str, raw: bytes) -> None:
+        task = asyncio.get_running_loop().create_task(
+            self._inner.send(dest, raw)
+        )
+        self._bg.add(task)
+
+        def _done(t: asyncio.Task) -> None:
+            self._bg.discard(t)
+            if not t.cancelled():
+                t.exception()  # consume: a late send into a closed
+                # transport must not log 'exception never retrieved'
+
+        task.add_done_callback(_done)
+
+    async def broadcast(self, raw: bytes, dests) -> None:
+        # per-dest send so each link's shape applies independently
+        for dest in dests:
+            if dest != self.node_id:
+                await self.send(dest, raw)
+
+    async def recv(self) -> bytes:
+        return await self._inner.recv()
+
+    def recv_nowait(self):
+        return self._inner.recv_nowait()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def find_shaped(transport) -> Optional[ShapedTransport]:
+    """Walk a wrapper chain (byzantine wrappers may stack over shaping)
+    to the ShapedTransport, if any."""
+    seen = 0
+    t = transport
+    while t is not None and seen < 8:
+        if isinstance(t, ShapedTransport):
+            return t
+        t = getattr(t, "_inner", None)
+        seen += 1
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -422,6 +896,80 @@ class ForkingCheckpointer(ByzantineTransport):
         return raw
 
 
+class StaleEpochVoter(ByzantineTransport):
+    """A replica removed by a committed reconfiguration that refuses to
+    leave: it keeps emitting consensus votes (prepare/commit/checkpoint)
+    into the NEW epoch's committee. The frames are validly signed with
+    its still-published key — the defense is the role gate (honest
+    replicas admit consensus traffic only from the CURRENT epoch's
+    replica set, replica._batch_items), and the detection surface is
+    `dropped_precheck` climbing on every honest node while the ledgers
+    stay clean. ``mark_stale()`` is called at the epoch boundary; until
+    then the wrapper is a pure passthrough."""
+
+    VOTE_KINDS = (b'"kind":"prepare"', b'"kind":"commit"',
+                  b'"kind":"checkpoint"', b'"kind":"preprepare"')
+
+    def __init__(self, inner, signer: Signer) -> None:
+        super().__init__(inner, signer)
+        self.stale = False
+        self._arm_when = None  # optional predicate: stale once it's True
+
+    def mark_stale(self) -> None:
+        self.stale = True
+
+    def arm_when(self, predicate) -> None:
+        """Defer staleness to a condition — FaultInjector arms schedule-
+        driven voters on the replica's removal from the committed
+        membership, so votes sent while still a LEGITIMATE member are
+        never counted as injections (they are ordinary honest traffic,
+        not byzantine behavior)."""
+        self._arm_when = predicate
+
+    def _count(self, raw: bytes) -> None:
+        if not self.stale and self._arm_when is not None and self._arm_when():
+            self.stale = True
+        if self.stale and any(k in raw for k in self.VOTE_KINDS):
+            self.injections += 1
+
+    async def send(self, dest, raw):
+        self._count(raw)
+        await self._inner.send(dest, raw)
+
+    async def broadcast(self, raw, dests):
+        self._count(raw)
+        await self._inner.broadcast(raw, dests)
+
+
+class ForgedSnapshotServer(ByzantineTransport):
+    """Feeds a joiner a forged checkpoint: every outbound state-transfer
+    payload (chunked StateChunkReply and legacy StateResponse) has its
+    snapshot bytes corrupted deterministically. The signature over the
+    LIE is valid — the joiner's only defense is the certified checkpoint
+    digest, which the assembled snapshot must hash to
+    (consensus/statesync.py); a mismatch discards the transfer and
+    re-fetches from another peer."""
+
+    def _mutate(self, raw: bytes) -> bytes:
+        if (b'"kind":"statechunkreply"' not in raw
+                and b'"kind":"stateresponse"' not in raw):
+            return raw
+        try:
+            msg = Message.from_wire(raw)
+        except ValueError:
+            return raw
+        kind = getattr(type(msg), "KIND", "")
+        if kind == "statechunkreply" and msg.sender == self.node_id:
+            msg.data = msg.data[::-1] if msg.data else "00"
+        elif kind == "stateresponse" and msg.sender == self.node_id:
+            msg.snapshot = msg.snapshot[::-1] if msg.snapshot else "{}"
+        else:
+            return raw
+        self.signer.sign_msg(msg)
+        self.injections += 1
+        return msg.to_wire()
+
+
 # ---------------------------------------------------------------------------
 # the injector
 # ---------------------------------------------------------------------------
@@ -507,8 +1055,15 @@ class FaultInjector:
             ok = self._slow_window(ev)
         elif ev.kind == "stall_device":
             ok = self._stall(ev)
-        elif ev.kind in ("equivocate", "fork_checkpoint"):
+        elif ev.kind in ("equivocate", "fork_checkpoint", "stale_epoch",
+                         "forge_statesync"):
             ok = self._byzantine(ev)
+        elif ev.kind == "partition":
+            ok = self._partition(ev)
+        elif ev.kind == "heal":
+            ok = self._heal_all()
+        elif ev.kind == "shape":
+            ok = self._shape(ev)
         else:
             ok = False
         rec["applied"] = ok
@@ -565,15 +1120,119 @@ class FaultInjector:
         kp = keys.get(r.id) if keys else None
         if kp is None:
             return False  # no key material: cannot sign the forks
-        cls = (
-            EquivocatingPrimary if ev.kind == "equivocate"
-            else ForkingCheckpointer
-        )
+        cls = {
+            "equivocate": EquivocatingPrimary,
+            "fork_checkpoint": ForkingCheckpointer,
+            "stale_epoch": StaleEpochVoter,
+            "forge_statesync": ForgedSnapshotServer,
+        }[ev.kind]
         if isinstance(r.transport, cls):
             return False  # already byzantine this way
         wrapper = cls(r.transport, Signer(r.id, kp.seed))
+        if ev.kind == "stale_epoch":
+            # The honest retiree self-gags at _send_vote, so a voter
+            # armed on `retired` alone never sees a vote frame (vacuous:
+            # injections stays 0 and the role gate goes unexercised).
+            # The byzantine replica REFUSES its retirement — it keeps
+            # voting — and staleness is judged against the ground truth
+            # of the committed membership, not the (now unset) gag flag.
+            # Until the removal actually commits its votes are ordinary
+            # member traffic and must not count as injections.
+            r.refuse_retirement = True
+            if r.id not in r.cfg.replica_ids:
+                r.retired = False  # already removed: un-gag now
+                wrapper.mark_stale()
+            else:
+                wrapper.arm_when(
+                    lambda rep=r: rep.id not in rep.cfg.replica_ids
+                )
         r.transport = wrapper
         self.byzantine.append(wrapper)
+        return True
+
+    # -- WAN shaping / partitions (ShapedTransport seam) -------------------
+
+    def _shaped(self, replica) -> ShapedTransport:
+        """The replica's ShapedTransport, wrapping its current transport
+        chain on first use (shaping composes OUTSIDE byzantine wrappers,
+        so forged frames ride the same degraded links)."""
+        shaped = find_shaped(replica.transport)
+        if shaped is None:
+            shaped = ShapedTransport(
+                replica.transport,
+                seed=self.schedule.seed ^ _node_seed(replica.id),
+            )
+            replica.transport = shaped
+        return shaped
+
+    def _replica_by_id(self, rid: str):
+        return next(
+            (x for x in self.committee.replicas if x.id == rid), None
+        )
+
+    def _partition(self, ev: FaultEvent) -> bool:
+        ids = list(self.committee.cfg.replica_ids)
+        try:
+            srcs, dsts, sym = parse_partition_spec(ev.spec, ids)
+        except ValueError:
+            return False
+        cuts: List[Tuple[ShapedTransport, Set[str]]] = []
+
+        def cut(from_ids: Set[str], to_ids: Set[str]) -> None:
+            for rid in from_ids:
+                r = self._replica_by_id(rid)
+                if r is None:
+                    continue
+                shaped = self._shaped(r)
+                added = (to_ids - {rid}) - shaped.cut_to
+                shaped.partition(to_ids)
+                if added:
+                    cuts.append((shaped, added))
+
+        cut(srcs, dsts)
+        if sym:
+            cut(dsts, srcs)
+        if not cuts:
+            return False
+        if ev.duration > 0:
+            def restore():
+                # remove exactly the pairs THIS window opened; an
+                # overlapping window that cut the same pair re-cuts on
+                # its own fire, so the earliest close wins (documented
+                # in docs/SCENARIOS.md — prefer explicit heal= when
+                # composing overlapping partitions)
+                for shaped, added in cuts:
+                    shaped.heal(added)
+
+            self._after(ev.duration, restore)
+        return True
+
+    def _heal_all(self) -> bool:
+        for r in self.committee.replicas:
+            shaped = find_shaped(r.transport)
+            if shaped is not None:
+                shaped.heal()
+        net = getattr(self.committee, "net", None)
+        faults = getattr(net, "faults", None)
+        if faults is not None and hasattr(faults, "heal"):
+            faults.heal()  # FaultPlan-based cuts heal too
+        return True
+
+    def _shape(self, ev: FaultEvent) -> bool:
+        if ev.spec not in WAN_PROFILES:
+            return False
+        ids = list(self.committee.cfg.replica_ids)
+        shaped_all: List[ShapedTransport] = []
+        for r in self.committee.replicas:
+            shaped = self._shaped(r)
+            shaped.apply_profile(ev.spec, ids, seed=self.schedule.seed)
+            shaped_all.append(shaped)
+        if ev.duration > 0:
+            def restore():
+                for shaped in shaped_all:
+                    shaped.clear_shaping()
+
+            self._after(ev.duration, restore)
         return True
 
     def _net_window(self, ev: FaultEvent) -> bool:
@@ -654,3 +1313,11 @@ class FaultInjector:
         # unconditionally — the restore can never be skipped
         task.add_done_callback(lambda _t: fn())
         self._restores.append(task)
+
+
+# Regenerate the kind documentation from the registry (ISSUE 7
+# satellite: the docstring and parse errors once named only the
+# pre-PR-5 kinds — now they cannot drift, tests assert the sync).
+_TABLE = "\n\nFault kinds (generated from KIND_REGISTRY):\n\n" + kind_table() + "\n"
+__doc__ = (__doc__ or "") + _TABLE
+FaultSchedule.__doc__ = (FaultSchedule.__doc__ or "") + _TABLE

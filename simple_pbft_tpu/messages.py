@@ -377,6 +377,13 @@ class Reply(Message):
     #: an in-band reserved result string — nothing stops an application
     #: from legitimately storing/returning any string.
     superseded: int = 0
+    #: committee configuration epoch the executing replica was in
+    #: (ISSUE 7: live membership reconfiguration). A client holding a
+    #: stale address book sees epoch > its own in any reply and
+    #: re-resolves the committee via ConfigFetch instead of timing out
+    #: against removed replicas. Deterministic across honest replicas:
+    #: epoch activation is a function of the agreed executed history.
+    epoch: int = 0
     #: hex HMAC-SHA256 over signing_payload() under the per-(replica,
     #: client) shared key (crypto/mac.py) — the point-to-point fast path;
     #: either ``mac`` or ``sig`` authenticates a reply, never both needed.
@@ -595,6 +602,61 @@ class StateResponse(Message):
 
 
 @dataclass
+class StateChunkRequest(Message):
+    """Ask a peer for chunk ``index`` of the snapshot at stable
+    checkpoint ``seq`` (consensus/statesync.py — the bounded, resumable
+    replacement for shipping the whole snapshot in one StateResponse).
+    Chunk size is the SERVER's statesync.CHUNK_BYTES; the requester
+    learns the chunk count from the first reply's ``total``."""
+
+    KIND: ClassVar[str] = "statechunkrequest"
+
+    seq: int = 0
+    index: int = 0
+
+
+@dataclass
+class StateChunkReply(Message):
+    """One snapshot chunk: ``data`` is ``snapshot[index*C:(index+1)*C]``.
+    Chunks are NOT individually trusted — the assembled snapshot must
+    hash to the 2f+1-certified checkpoint digest (the same authority the
+    legacy StateResponse path uses), so a byzantine server can only cost
+    a re-fetch, never a forged install."""
+
+    KIND: ClassVar[str] = "statechunkreply"
+
+    seq: int = 0
+    index: int = 0
+    total: int = 0  # chunk count for this snapshot
+    data: str = ""
+
+
+@dataclass
+class ConfigFetch(Message):
+    """Client -> replica: send me the committee configuration for
+    ``epoch`` (or your latest). Fired when a reply's epoch outruns the
+    client's address book after a live reconfiguration (ISSUE 7)."""
+
+    KIND: ClassVar[str] = "configfetch"
+
+    epoch: int = 0
+
+
+@dataclass
+class ConfigReply(Message):
+    """A replica's signed committee configuration: ``config`` is the
+    canonical JSON of config.config_doc() (epoch, replica_ids, pubkeys).
+    A client adopts a config only when f+1 KNOWN replicas (keys it
+    already holds) agree on the same config bytes for the same epoch —
+    one lying replica cannot steer a client into a fake committee."""
+
+    KIND: ClassVar[str] = "configreply"
+
+    epoch: int = 0
+    config: str = ""
+
+
+@dataclass
 class BlockFetch(Message):
     """Ask peers for blocks by digest — view-change certificates ship
     digest-only pre-prepares (see PrePrepare.signing_payload), so a
@@ -659,3 +721,18 @@ class NewViewFetch(Message):
 EMPTY_BLOCK_DIGEST = PrePrepare.block_digest([])
 
 ALL_KINDS = tuple(sorted(_REGISTRY))
+
+# DEFERRABLE message classes: every sender here has its own retry path
+# (clients back off and retransmit, fetch/probe requesters re-fire on
+# their own timers), so a dropped instance costs one retransmission.
+# Everything else is quorum-critical by default — an unlisted class is
+# KEPT, the safe polarity for consensus liveness. This tuple is the
+# SINGLE source for both consumers: replica.SHED_DEFERRABLE (overload
+# shedding, pre-verify) and tcp._DEFERRABLE_KINDS (mid-write requeue /
+# reconnect-drain policy) — hosted here so the transport never imports
+# the consensus layer and the two sets cannot drift.
+DEFERRABLE = (
+    Request, SlotFetch, BlockFetch, StateRequest, NewViewFetch,
+    StateChunkRequest, ConfigFetch,
+)
+DEFERRABLE_KINDS = frozenset(c.KIND for c in DEFERRABLE)

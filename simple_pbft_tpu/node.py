@@ -144,6 +144,16 @@ async def run_node(args) -> None:
     seed = deploy.read_seed(args.deploy_dir, args.id)
     transport = make_transport(args.transport, args.id, dep)
     await transport.start()
+    if getattr(args, "wan_profile", ""):
+        # WAN rehearsal (ISSUE 7): impose the named profile's per-link
+        # latency/jitter/loss on this node's OUTBOUND links. Every node
+        # of the committee should run the same profile so both directions
+        # of each pair are shaped (docs/SCENARIOS.md).
+        from .faults import ShapedTransport
+
+        transport = ShapedTransport.wrap_profile(
+            transport, args.wan_profile, list(dep.cfg.replica_ids)
+        )
     replica = Replica(
         node_id=args.id,
         cfg=dep.cfg,
@@ -289,6 +299,13 @@ def main() -> None:
         default="tcp",
         choices=["tcp", "grpc"],
         help="wire transport (grpc = HTTP/2 streams, the DCN path)",
+    )
+    ap.add_argument(
+        "--wan-profile", default="",
+        help="wrap the wire transport in a deterministic link shaper "
+        "(faults.ShapedTransport) with the named WAN profile — wan3dc "
+        "(three datacenters, ~12 ms inter-DC), lossy (5%% iid loss) — "
+        "for degraded-network rehearsals (docs/SCENARIOS.md)",
     )
     ap.add_argument(
         "--max-drain", type=int, default=4096,

@@ -82,6 +82,14 @@ def replica_snapshot(replica) -> Dict[str, Any]:
         "view": replica.view,
         "is_primary": replica.is_primary,
         "in_view_change": bool(replica.vc.in_view_change),
+        # live-reconfiguration state (ISSUE 7): committee epoch, whether
+        # this replica was retired by a committed config change, and
+        # whether a chunked state transfer is currently in flight
+        "epoch": getattr(replica.cfg, "epoch", 0),
+        "retired": bool(getattr(replica, "retired", False)),
+        "statesync_active": bool(
+            getattr(getattr(replica, "statesync", None), "syncing", False)
+        ),
         "executed_seq": replica.executed_seq,
         "stable_seq": replica.stable_seq,
         "next_seq": replica.next_seq,
@@ -97,11 +105,21 @@ def replica_snapshot(replica) -> Dict[str, Any]:
 
 def transport_snapshot(transport) -> Dict[str, Any]:
     """Wire-level counters; every transport exposes a ``metrics`` dict
-    (tcp/grpc natively, local endpoints since this module landed)."""
-    return {
+    (tcp/grpc natively, local endpoints since this module landed). A
+    node whose transport chain includes a faults.ShapedTransport also
+    reports its link-shaping state (active WAN profile, open partition
+    cuts, loss/partition drop counters) — pbft_top's NET column."""
+    snap = {
         "kind": type(transport).__name__,
         "metrics": dict(getattr(transport, "metrics", {}) or {}),
     }
+    shaping = getattr(transport, "shaping_snapshot", None)
+    if callable(shaping):
+        try:
+            snap["shaping"] = shaping()
+        except Exception:  # noqa: BLE001 — telemetry never raises inward
+            pass
+    return snap
 
 
 def verify_service_snapshot(verifier) -> Dict[str, Any]:

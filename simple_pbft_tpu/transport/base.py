@@ -5,6 +5,30 @@ from __future__ import annotations
 from typing import Iterable, Optional, Protocol
 
 
+def update_peer_book(transport, addrs) -> int:
+    """Push ``id -> (host, port)`` entries into every peer book found in
+    a transport wrapper chain (ShapedTransport / byzantine wrappers hold
+    the socket transport behind ``_inner``). Socket transports route by
+    their ``peers`` dict — without this, a reconfiguration-added member
+    is unreachable over tcp/grpc (``send`` silently drops unknown dests)
+    even though the committed config names it. Id-routed transports
+    (local) have no book and ignore the call. Returns entries changed."""
+    t, changed = transport, 0
+    while t is not None:
+        peers = getattr(t, "peers", None)
+        if isinstance(peers, dict):
+            own = getattr(t, "node_id", None)
+            for rid, hp in addrs.items():
+                if rid == own:
+                    continue  # a peer book never routes to itself
+                entry = (str(hp[0]), int(hp[1]))
+                if peers.get(rid) != entry:
+                    peers[rid] = entry
+                    changed += 1
+        t = getattr(t, "_inner", None)
+    return changed
+
+
 class Transport(Protocol):
     """One node's handle on the network. Sends are fire-and-forget (the
     reference's semantics: http.Post with the response ignored,

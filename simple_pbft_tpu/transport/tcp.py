@@ -24,6 +24,8 @@ import asyncio
 import logging
 from typing import Dict, Iterable, Optional, Tuple
 
+from ..messages import DEFERRABLE_KINDS
+
 log = logging.getLogger("pbft.tcp")
 
 # Must admit the largest certificate message (NewView's 256 MiB cap,
@@ -39,6 +41,44 @@ OUTBOX_DEPTH = 4096  # per-peer queued frames before drops (slow peer)
 
 def encode_frame(raw: bytes) -> bytes:
     return len(raw).to_bytes(4, "big") + raw
+
+
+# DEFERRABLE message kinds (messages.DEFERRABLE — the single source
+# shared with the replica's SHED_DEFERRABLE, so the two policies can't
+# drift): their senders all have retry paths, so a frame lost mid-write
+# just costs one retransmission. Everything else is treated as
+# quorum-critical — a vote, certificate, or repair payload is emitted
+# once, and losing it to a connection blip heals only through the much
+# slower probe/view-change machinery — and gets ONE requeue before the
+# transport gives up on it.
+_DEFERRABLE_KINDS = DEFERRABLE_KINDS
+
+
+def _deferrable(raw: bytes) -> bool:
+    """TOP-LEVEL kind check. Not a substring scan: pre-prepares and
+    NEW-VIEWs EMBED client requests, so their wire bytes contain
+    '\"kind\":\"request\"' while being exactly the once-emitted frames
+    the requeue guarantee exists for. Only consulted on exceptional
+    paths (mid-write failure, reconnect drain), so the parse cost is
+    off the hot path; unparseable frames count as critical (requeue is
+    the safe polarity)."""
+    try:
+        import json
+
+        return json.loads(raw)["kind"] in _DEFERRABLE_KINDS
+    except Exception:
+        return False
+
+
+def _item_deferrable(item: list) -> bool:
+    """Memoized per-item verdict: outbox items are [raw, retried, defer]
+    with defer lazily filled on first consultation. A long outage runs
+    the reconnect drain every backoff tick over the same queued frames —
+    without the memo each tick would re-json.loads the entire outbox
+    (pre-prepares carry whole request blocks) on the shared event loop."""
+    if item[2] is None:
+        item[2] = _deferrable(item[0])
+    return item[2]
 
 
 class TcpTransport:
@@ -72,6 +112,12 @@ class TcpTransport:
             "dropped_outbox": 0,
             "dropped_recv": 0,
             "reconnects": 0,
+            # frames that died mid-write (connection failed with the
+            # frame already dequeued) and were lost for good / requeued
+            # once because they were quorum-critical (ISSUE 7 satellite:
+            # these were previously silent — "this one is lost")
+            "frames_dropped": 0,
+            "frames_requeued": 0,
         }
 
     # -- lifecycle ------------------------------------------------------
@@ -154,11 +200,16 @@ class TcpTransport:
         """Own the connection to one peer: (re)connect, drain the outbox.
         Connection failures drop queued frames after a few attempts —
         fire-and-forget, like the reference's ignored http.Post errors
-        (node.go:121), but bounded and metered."""
+        (node.go:121), but bounded and metered. A frame that fails
+        MID-WRITE is no longer silently lost: it is counted
+        (frames_dropped) and, when quorum-critical, requeued exactly once
+        (frames_requeued) so a connection blip doesn't eat a vote or
+        certificate that is emitted exactly once."""
         backoff = 0.05
         writer: Optional[asyncio.StreamWriter] = None
         while True:
-            raw = await q.get()
+            item = await q.get()
+            raw, retried = item[0], item[1]
             while writer is None:
                 host, port = self.peers[dest]
                 try:
@@ -168,19 +219,43 @@ class TcpTransport:
                     self.metrics["reconnects"] += 1
                     await asyncio.sleep(backoff)
                     backoff = min(backoff * 2, 2.0)
-                    # drain whatever piled up while the peer was down —
-                    # PBFT retransmits; stale frames only add load
+                    # drain the DEFERRABLE frames that piled up while the
+                    # peer was down — their senders retransmit; stale
+                    # copies only add load. Quorum-critical frames (votes,
+                    # certs — emitted exactly once, possibly requeued from
+                    # a mid-write failure above) are kept: discarding them
+                    # here would void the requeue guarantee right when the
+                    # link is flapping.
                     dropped = 0
-                    while q.qsize() > OUTBOX_DEPTH // 2:
-                        q.get_nowait()
-                        dropped += 1
+                    kept = []
+                    while (
+                        q.qsize() + len(kept) > OUTBOX_DEPTH // 2
+                        and q.qsize() > 0
+                    ):
+                        qi = q.get_nowait()
+                        if _item_deferrable(qi):
+                            dropped += 1
+                        else:
+                            kept.append(qi)
+                    for qi in kept:
+                        q.put_nowait(qi)
                     self.metrics["dropped_outbox"] += dropped
             try:
                 writer.write(encode_frame(raw))
                 await writer.drain()
                 self.metrics["sent"] += 1
             except (ConnectionError, OSError):
-                writer = None  # reconnect on next frame; this one is lost
+                writer = None  # reconnect on next frame
+                requeued = False
+                if not retried and not _item_deferrable(item):
+                    try:
+                        q.put_nowait([raw, True, item[2]])
+                        requeued = True
+                        self.metrics["frames_requeued"] += 1
+                    except asyncio.QueueFull:
+                        pass
+                if not requeued:
+                    self.metrics["frames_dropped"] += 1
 
     # -- Transport interface -------------------------------------------
 
@@ -195,7 +270,7 @@ class TcpTransport:
         if dest not in self.peers:
             return  # unknown destination: fire-and-forget semantics
         try:
-            self._outbox(dest).put_nowait(raw)
+            self._outbox(dest).put_nowait([raw, False, None])
         except asyncio.QueueFull:
             self.metrics["dropped_outbox"] += 1
 

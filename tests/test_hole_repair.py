@@ -98,7 +98,11 @@ def test_failover_defers_while_committee_commits():
         plan = FaultPlan(seed=11)
         com = LocalCommittee.build(
             n=4, clients=1, fault_plan=plan, qc_mode=True,
-            view_timeout=0.6, checkpoint_interval=512,
+            # 1.5 s: like the catch-up test above, the assertion is
+            # BEHAVIORAL (no failover while commits advance) — at 0.6 s a
+            # saturated full-suite host stalls the loop past the timer
+            # with no observable progress and fires it spuriously
+            view_timeout=1.5, checkpoint_interval=512,
         )
         com.start()
         c = com.clients[0]
@@ -111,7 +115,8 @@ def test_failover_defers_while_committee_commits():
         # park client work on the victim so its timer arms: relay a
         # request through it by healing first (normal traffic resumes)
         await _pump_n(c, 8, "post")
-        await asyncio.sleep(1.5)
+        # long enough for an (incorrectly) undeferred timer to fire
+        await asyncio.sleep(2.5)
         assert sum(
             r.metrics.get("view_changes_started", 0) for r in com.replicas
         ) == 0

@@ -36,8 +36,34 @@ from typing import Dict, List, Optional, Tuple
 COLUMNS = (
     "NODE", "SRC", "VIEW", "ROLE", "EXEC", "STABLE", "CAGE", "BACKLOG",
     "VQ", "QCQ", "QCB", "PAIRms", "SHED", "DEG", "QUAR", "REJ", "WDOG",
-    "AUD", "RTTms", "LAGms", "REQ/s",
+    "AUD", "NET", "RTTms", "LAGms", "REQ/s",
 )
+
+
+def net_cell(snap: dict) -> str:
+    """NET: per-node partition/shaping state (ISSUE 7). Composed from the
+    transport block's ``shaping`` sub-snapshot (faults.ShapedTransport):
+    the active WAN profile, open outbound cuts ("!2cut"), and a lost-frame
+    signal ("~N" = loss + partition drops). A node syncing state shows
+    "sync". Blank = unshaped, healthy links."""
+    parts = []
+    rep = snap.get("replica") or {}
+    shaping = (snap.get("transport") or {}).get("shaping") or {}
+    if shaping.get("profile"):
+        parts.append(str(shaping["profile"]))
+    cuts = shaping.get("cut_to") or []
+    if cuts:
+        parts.append(f"!{len(cuts)}cut")
+    lost = (
+        shaping.get("shaped_lost", 0) + shaping.get("partition_dropped", 0)
+    )
+    if lost:
+        parts.append(f"~{lost}")
+    if rep.get("statesync_active"):
+        parts.append("sync")
+    if rep.get("retired"):
+        parts.append("retired")
+    return "+".join(parts)
 
 
 def scrape_endpoint(hostport: str, timeout: float = 2.0) -> Optional[dict]:
@@ -186,6 +212,7 @@ def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
         str(ver.get("overload_rejections", "")),
         str(ver.get("watchdog_failovers", "")),
         aud_cell,
+        net_cell(snap),
         (f"{ver['rtt_ms_ema']:.0f}" if "rtt_ms_ema" in ver else ""),
         (f"{lag['ema_ms']:.1f}" if "ema_ms" in lag else ""),
         rate,
