@@ -11,7 +11,7 @@ batching / checkpoint / watermark knobs the reference lacks.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Iterable, Optional, Tuple
 
 from .crypto import ed25519_cpu
 
@@ -123,7 +123,7 @@ def config_doc(cfg: CommitteeConfig) -> Dict[str, object]:
     }
 
 
-def config_from_doc(base: CommitteeConfig, doc: Dict[str, object]) -> CommitteeConfig:
+def config_from_doc(base: CommitteeConfig, doc: Dict[str, Any]) -> CommitteeConfig:
     """Rebuild a CommitteeConfig from a config_doc, inheriting every
     non-membership knob (timeouts, batching, qc_mode, ...) from
     ``base``. Raises ValueError on a malformed doc — snapshot installs
@@ -163,7 +163,7 @@ def config_from_doc(base: CommitteeConfig, doc: Dict[str, object]) -> CommitteeC
 def apply_reconfig(
     cfg: CommitteeConfig,
     add: Dict[str, Dict[str, str]],
-    remove,
+    remove: Iterable[str],
 ) -> CommitteeConfig:
     """The committed membership change: remove ids, append new replicas
     (sorted, after the survivors — rotation order must be identical on
@@ -231,7 +231,7 @@ class KeyPair:
 
 
 def make_test_committee(
-    n: int = 4, clients: int = 1, **overrides
+    n: int = 4, clients: int = 1, **overrides: Any
 ) -> Tuple[CommitteeConfig, Dict[str, KeyPair]]:
     """Deterministic committee for tests/benchmarks: replicas r0..r{n-1},
     clients c0..c{clients-1}, keys derived from ids."""

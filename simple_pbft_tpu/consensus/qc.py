@@ -34,7 +34,7 @@ from collections import OrderedDict
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
-from .. import spans
+from .. import sanitize, spans
 from ..crypto import bls
 from ..messages import QuorumCert, qc_payload
 
@@ -47,7 +47,7 @@ PHASES = VOTE_PHASES + ("checkpoint",)
 
 _CACHE_MAX = 4096
 _cache: "OrderedDict[tuple, bool]" = OrderedDict()
-_cache_lock = threading.Lock()
+_cache_lock = sanitize.wrap_lock(threading.Lock(), "qc.cache")
 # key -> Event for a pairing currently being computed: concurrent callers
 # of the same certificate (every backup receives the primary's broadcast
 # at once) wait for the first computation instead of redundantly burning
@@ -287,7 +287,9 @@ class QcVerifyLane:
         self._max_pending = max_pending
         self._max_batch = max_batch
         self._close_window = close_window
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(
+            sanitize.wrap_lock(threading.Lock(), "qc.lane.cond")
+        )
         self._pending: "OrderedDict[tuple, _LaneEntry]" = OrderedDict()
         self._inflight_entries: Dict[tuple, _LaneEntry] = {}
         self._closed = False
@@ -386,24 +388,32 @@ class QcVerifyLane:
         return take
 
     def _worker(self) -> None:
-        while True:
-            with self._cond:
-                while not self._pending and not self._closed:
-                    self._cond.wait()
-                if self._closed and not self._pending:
-                    return
-                if (
-                    self._close_window > 0
-                    and not self._closed
-                    and len(self._pending) < self._max_batch
-                ):
-                    # batch-close: let the rest of a broadcast burst land
-                    self._cond.wait(self._close_window)
-                take = self._take_locked()
-            if take:
-                self._run_batch(take)
+        sanitize.bind_owner(("qc.lane.worker", id(self)), "QcVerifyLane._worker")
+        try:
+            while True:
+                with self._cond:
+                    while not self._pending and not self._closed:
+                        self._cond.wait()
+                    if self._closed and not self._pending:
+                        return
+                    if (
+                        self._close_window > 0
+                        and not self._closed
+                        and len(self._pending) < self._max_batch
+                    ):
+                        # batch-close: let the rest of a broadcast burst land
+                        self._cond.wait(self._close_window)
+                    take = self._take_locked()
+                if take:
+                    self._run_batch(take)
+        finally:
+            # a later lane at this recycled id() must bind fresh
+            sanitize.release_owner(("qc.lane.worker", id(self)))
 
     def _run_batch(self, take: List[_LaneEntry]) -> None:
+        # pairing work is confined to the lane worker: a pairing on any
+        # other thread (the loop!) is exactly the r5 wedge shape
+        sanitize.check_owner(("qc.lane.worker", id(self)), "QcVerifyLane._run_batch")
         t0 = time.perf_counter()
         for e in take:
             # lane wait per certificate: submit -> batch start (includes
@@ -488,7 +498,7 @@ class QcVerifyLane:
         }
 
 
-_lane_lock = threading.Lock()
+_lane_lock = sanitize.wrap_lock(threading.Lock(), "qc.lane_registry")
 _lane: Optional[QcVerifyLane] = None
 
 

@@ -517,7 +517,10 @@ class Replica:
     def _start_sweep(self, sweep: List[bytes]):
         """Decode a sweep and launch its signature verification in a
         worker thread (hashlib and the device round trip both release the
-        GIL / the loop). Returns (decoded, spans, verify_task | None)."""
+        GIL / the loop). Returns (decoded, sig_spans, verify_task |
+        None). The per-message item ranges are named sig_spans: a local
+        called ``spans`` shadows the telemetry module imported above
+        (pbftlint PBL004 caught exactly that wart here)."""
         decoded: List[Message] = []
         for raw in sweep:
             try:
@@ -526,14 +529,14 @@ class Replica:
                 self.metrics["malformed"] += 1
         decoded = self._shed_for_overload(decoded)
         self.stats.sweep_size.record(len(sweep))
-        spans: List[Tuple[int, int]] = []
+        sig_spans: List[Tuple[int, int]] = []
         verify_task = None
         if decoded and self.cfg.verify_signatures:
             items: List[BatchItem] = []
             for msg in decoded:
                 start = len(items)
                 items.extend(self._batch_items(msg))
-                spans.append((start, len(items)))
+                sig_spans.append((start, len(items)))
             if items:
                 if hasattr(self.verifier, "submit"):
                     # coalescing service (crypto/coalesce.py): await the
@@ -551,7 +554,7 @@ class Replica:
                         asyncio.to_thread(self._timed_verify, items)
                     )
             self.metrics["verified_sigs"] += len(items)
-        return decoded, spans, verify_task
+        return decoded, sig_spans, verify_task
 
     def _shed_for_overload(self, decoded: List[Message]) -> List[Message]:
         """Priority-class load shedding (ISSUE 1 tentpole). A sweep past
@@ -674,7 +677,7 @@ class Replica:
         self._record_verify(len(fresh), time.perf_counter() - t0)
         return out
 
-    async def _finish_sweep(self, decoded, spans, verify_task) -> None:
+    async def _finish_sweep(self, decoded, sig_spans, verify_task) -> None:
         if not decoded:
             return
         t0 = time.perf_counter()
@@ -693,7 +696,7 @@ class Replica:
                 self.metrics["degraded_mode"] = 1
                 return
             accepted = []
-            for msg, (s, e) in zip(decoded, spans):
+            for msg, (s, e) in zip(decoded, sig_spans):
                 if s == e:
                     # structurally inadmissible or redundant (no signature
                     # items were even collected) — NOT a forged signature;
@@ -718,8 +721,8 @@ class Replica:
         """Decode a sweep of wire messages, batch-verify every signature in
         it with ONE verifier call, then route the survivors. (Direct-drive
         entry for tests; the runtime pipelines the same two halves.)"""
-        decoded, spans, verify_task = self._start_sweep(sweep)
-        await self._finish_sweep(decoded, spans, verify_task)
+        decoded, sig_spans, verify_task = self._start_sweep(sweep)
+        await self._finish_sweep(decoded, sig_spans, verify_task)
 
     def _batch_items(self, msg: Message) -> List[BatchItem]:
         """Signature obligations for one message. An empty return means the

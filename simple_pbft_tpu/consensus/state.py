@@ -24,9 +24,9 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple, Union
 
-from ..messages import Commit, PrePrepare, Prepare
+from ..messages import Commit, PrePrepare, Prepare, QuorumCert
 
 
 class Stage(enum.Enum):
@@ -61,7 +61,7 @@ class ExecuteBlock:
     block: List[Dict[str, Any]]
 
 
-Action = Any  # SendPrepare | SendCommit | ExecuteBlock
+Action = Union[SendPrepare, SendCommit, ExecuteBlock]
 
 
 @dataclass
@@ -83,8 +83,8 @@ class Instance:
     # QuorumCerts, not by counting votes locally — votes flow to the
     # primary only, so a backup's vote logs never reach quorum.
     qc_mode: bool = False
-    prepare_qc: Optional[Any] = None  # verified QuorumCert(phase=prepare)
-    commit_qc: Optional[Any] = None
+    prepare_qc: Optional[QuorumCert] = None  # verified, phase=prepare
+    commit_qc: Optional[QuorumCert] = None
     t_started: float = 0.0  # perf_counter at pre-prepare admission (stats)
     # phase-transition clocks (ISSUE 4 spans): set by the runtime when
     # the slot prepares / its commit certificate forms, so the three
@@ -102,7 +102,7 @@ class Instance:
     # memory lever. The audit plane (audit.SafetyAuditor) independently
     # records the full signed evidence; this is only the state
     # machine's own breadcrumb.
-    conflicts: List[Any] = field(default_factory=list)
+    conflicts: List[Tuple[str, str]] = field(default_factory=list)
     # incremental counts of votes matching the fixed digest — counting
     # the logs on every arrival was O(n) per vote = O(n^2) per slot per
     # replica (measured ~7% of an n=100 committee's CPU)
@@ -111,7 +111,7 @@ class Instance:
 
     MAX_CONFLICTS = 4  # forensic breadcrumbs, not a log
 
-    def _note_conflict(self, msg) -> None:
+    def _note_conflict(self, msg: Union[PrePrepare, Prepare, Commit]) -> None:
         if len(self.conflicts) < self.MAX_CONFLICTS:
             self.conflicts.append((msg.sender, msg.digest))
 
@@ -157,7 +157,7 @@ class Instance:
         self.block = msg.block
         if self.stage == Stage.IDLE:
             self.stage = Stage.PRE_PREPARED
-        out: List[Action] = [SendPrepare(self.view, self.seq, self.digest)]
+        out: List[Action] = [SendPrepare(self.view, self.seq, msg.digest)]
         # Votes that arrived before the pre-prepare (buffered by pools) may
         # already form a quorum — re-evaluate.
         out.extend(self._maybe_advance())
@@ -212,10 +212,22 @@ class Instance:
             # local vote counts must not drive transitions
             return self._maybe_advance_qc()
         out: List[Action] = []
-        if self.stage == Stage.PRE_PREPARED and self.prepared():
+        # the is-not-None re-checks are implied by prepared()/committed()
+        # (a quorum fixes the digest and admits the block) but let mypy
+        # prove the Action fields are never None
+        if (
+            self.stage == Stage.PRE_PREPARED
+            and self.prepared()
+            and self.digest is not None
+        ):
             self.stage = Stage.PREPARED
             out.append(SendCommit(self.view, self.seq, self.digest))
-        if self.stage == Stage.PREPARED and self.committed():
+        if (
+            self.stage == Stage.PREPARED
+            and self.committed()
+            and self.digest is not None
+            and self.block is not None
+        ):
             self.stage = Stage.COMMITTED
             if not self.executed:
                 self.executed = True
@@ -226,7 +238,7 @@ class Instance:
 
     # -- QC-mode transitions -------------------------------------------------
 
-    def on_prepare_qc(self, qc) -> List[Action]:
+    def on_prepare_qc(self, qc: QuorumCert) -> List[Action]:
         """A VERIFIED prepare QC for this slot. The commit share is only
         emitted once our own pre-prepare is also held (_maybe_advance_qc):
         a replica in the commit quorum must be able to produce a P-set
@@ -245,7 +257,7 @@ class Instance:
             self._recount_matching()
         return self._maybe_advance_qc()
 
-    def on_commit_qc(self, qc) -> List[Action]:
+    def on_commit_qc(self, qc: QuorumCert) -> List[Action]:
         if (qc.view, qc.seq) != (self.view, self.seq):
             return []
         if self.digest is not None and qc.digest != self.digest:
@@ -265,6 +277,7 @@ class Instance:
             and self.pre_prepare is not None  # must be able to prove the
             # slot in a view change (prepared_proof needs the block)
             and self.stage in (Stage.IDLE, Stage.PRE_PREPARED)
+            and self.digest is not None  # fixed by the QC admission
         ):
             self.stage = Stage.PREPARED
             out.append(SendCommit(self.view, self.seq, self.digest))
@@ -274,6 +287,7 @@ class Instance:
             # a commit QC subsumes the prepare QC (2f+1 replicas held one);
             # execution still needs the block content from the pre-prepare
             and self.block is not None
+            and self.digest is not None
             and not self.executed
         ):
             self.stage = Stage.COMMITTED
@@ -307,6 +321,8 @@ class Instance:
         binds the content, so certificates ship digests and receivers
         refill blocks locally or via BlockFetch. This is what keeps
         VIEW-CHANGE/NEW-VIEW wires small under load."""
+        if self.pre_prepare is None:  # callers guard; keep mypy honest
+            raise RuntimeError("no pre-prepare admitted for this slot")
         d = self.pre_prepare.to_dict()
         d["block"] = []
         return d

@@ -39,7 +39,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import List, Optional, Sequence
 
-from .. import spans
+from .. import sanitize, spans
 from .verifier import BatchItem, Verifier, best_cpu_verifier
 
 
@@ -113,13 +113,17 @@ class VerifyService:
         self._quarantine_backoff = quarantine_base
         self._pending: deque = deque()  # (items, future, t_enqueued)
         self._pending_items = 0
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(
+            sanitize.wrap_lock(threading.Lock(), "verify_service.cond")
+        )
         self._inflight = 0
         self._closed = False
         self._started = False
         # completion queue: (finisher, subs, t_dispatch, n_items)
         self._done_q: deque = deque()
-        self._done_cond = threading.Condition()
+        self._done_cond = threading.Condition(
+            sanitize.wrap_lock(threading.Lock(), "verify_service.done_cond")
+        )
         # dispatch t0 of the device pass the completion thread is
         # currently waiting on (None = idle) — with the _done_q t0s this
         # gives snapshot() the age of the OLDEST outstanding dispatch,

@@ -269,12 +269,15 @@ class KeyBank:
         mode: str = "comb",
         window: int = 4,
     ):
-        assert mode in ("comb", "fused")
-        assert window in (4, 5, 6), window
+        if mode not in ("comb", "fused"):
+            raise ValueError(f"mode must be comb|fused, got {mode!r}")
+        if window not in (4, 5, 6):
+            raise ValueError(f"window must be 4|5|6, got {window!r}")
         self._mode = mode
         self.window = window
         if mode == "comb":
-            assert window == 4, "comb mode is fixed at 4-bit windows"
+            if window != 4:
+                raise ValueError("comb mode is fixed at 4-bit windows")
             self._builder = comb.comb_table_np
             self._rows_per_key = comb.NPOS * comb.WINDOW
             default_max = 1024  # ~260 KB/key
@@ -542,8 +545,10 @@ class TpuVerifier:
         window: int = 4,
         initial_keys: Optional[int] = None,
     ):
-        assert mode in ("comb", "fused", "ladder")
-        assert window == 4 or mode == "fused", "window is a fused-mode knob"
+        if mode not in ("comb", "fused", "ladder"):
+            raise ValueError(f"mode must be comb|fused|ladder, got {mode!r}")
+        if window != 4 and mode != "fused":
+            raise ValueError("window is a fused-mode knob")
         self._mesh = mesh
         self._mode = mode
         self._window = window

@@ -23,14 +23,14 @@ from __future__ import annotations
 import hashlib
 import json
 from dataclasses import dataclass, field, fields
-from typing import Any, ClassVar, Dict, List, Optional, Tuple, Type
+from typing import Any, Callable, ClassVar, Dict, List, Optional, Tuple, Type
 
 # ---------------------------------------------------------------------------
 # Canonical encoding helpers
 # ---------------------------------------------------------------------------
 
 
-_native_encode = None
+_native_encode: Optional[Callable[[Any], Optional[bytes]]] = None
 _native_checked = False
 
 
@@ -95,7 +95,19 @@ class Message:
     sender: str = ""
     sig: str = ""  # hex Ed25519 signature over signing_payload()
 
-    def __init_subclass__(cls, **kw):
+    # per-class decode caches, populated lazily by the classmethods
+    # below (ClassVar so the dataclass machinery never sees them as
+    # fields; Optional so mypy accepts the lazy-init protocol)
+    _FIELD_SPECS: ClassVar[
+        Optional[List[Tuple[str, Optional[type], type]]]
+    ] = None
+    _DEFAULT_SPEC: ClassVar[
+        Optional[
+            Tuple[Dict[str, Any], Tuple[Tuple[str, Callable[[], Any]], ...]]
+        ]
+    ] = None
+
+    def __init_subclass__(cls, **kw: Any) -> None:
         super().__init_subclass__(**kw)
         _REGISTRY[cls.KIND] = cls
 
@@ -157,7 +169,7 @@ class Message:
         return cls._build(d)
 
     @classmethod
-    def _field_specs(cls):
+    def _field_specs(cls) -> List[Tuple[str, Optional[type], type]]:
         """(name, want, elem) per dataclass field, computed once per class
         — decode runs per wire message on the replica hot path; re-parsing
         f.type strings there cost ~10% of a committee's CPU."""
@@ -165,10 +177,14 @@ class Message:
         if specs is None:
             specs = []
             for f in fields(cls):
-                want = {"int": int, "str": str}.get(f.type.split("[")[0])
-                if f.type.startswith("List[str]"):
-                    elem = str
-                elif f.type.startswith("List[int]"):
+                # under `from __future__ import annotations` f.type is
+                # the annotation STRING (typeshed says str | type, so
+                # normalize before parsing it)
+                ftype = f.type if isinstance(f.type, str) else f.type.__name__
+                want = {"int": int, "str": str}.get(ftype.split("[")[0])
+                if ftype.startswith("List[str]"):
+                    elem: type = str
+                elif ftype.startswith("List[int]"):
                     elem = int
                 else:
                     elem = dict
@@ -177,7 +193,9 @@ class Message:
         return specs
 
     @classmethod
-    def _default_spec(cls):
+    def _default_spec(
+        cls,
+    ) -> Tuple[Dict[str, Any], Tuple[Tuple[str, Callable[[], Any]], ...]]:
         """(plain-defaults dict, [(name, factory)]) per class, computed
         once — lets _build construct instances through __dict__ directly
         instead of the dataclass __init__/__setattr__ chain (one dict

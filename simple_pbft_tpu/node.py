@@ -108,6 +108,15 @@ def _dump_final(node_id: str, replica, transport, watchdog=None) -> None:
         # the accountability summary: did this node witness any safety
         # violation, and where its evidence ledger lives (docs/AUDIT.md)
         logging.info("%s: audit %s", node_id, auditor.snapshot())
+    from . import sanitize
+
+    viols = sanitize.take_violations()
+    if viols:
+        # an armed sanitizer's findings must reach the operator, not
+        # die with the process (violations never raise into consensus)
+        logging.warning(
+            "%s: %s", node_id, sanitize.format_violations(viols)
+        )
     if watchdog is not None:
         try:
             # a DISTINCT file: the shutdown snapshot must never overwrite
@@ -154,17 +163,26 @@ async def run_node(args) -> None:
         transport = ShapedTransport.wrap_profile(
             transport, args.wan_profile, list(dep.cfg.replica_ids)
         )
+    # verifier construction includes warm_for_population — minutes of
+    # XLA compiles on a cold cache. Run it off-loop: the transport is
+    # already started, and blocking the loop here stalls its accept /
+    # reconnect machinery (and every heartbeat) for the whole warm.
+    # Found by the PBFT_SANITIZE=loop sanitizer (ISSUE 8): the static
+    # checker cannot resolve the call (warm_for_population is not a
+    # unique method name) — exactly the dynamic-backstop case.
+    verifier = await asyncio.to_thread(
+        make_verifier,
+        args.verifier,
+        dep,
+        verify_max_pending=args.verify_max_pending,
+        verify_deadline=args.verify_deadline,
+    )
     replica = Replica(
         node_id=args.id,
         cfg=dep.cfg,
         seed=seed,
         transport=transport,
-        verifier=make_verifier(
-            args.verifier,
-            dep,
-            verify_max_pending=args.verify_max_pending,
-            verify_deadline=args.verify_deadline,
-        ),
+        verifier=verifier,
         max_drain=args.max_drain,
         shed_watermark=args.shed_watermark,
     )
@@ -388,6 +406,13 @@ def main() -> None:
     # the telemetry plane (flight recorder, trace sink, status-file
     # discovery) writes next to the rotating log
     args.resolved_log_dir = log_dir or None
+    # arm the opt-in loop sanitizer BEFORE the loop exists: install()
+    # wraps the policy's new_event_loop, so asyncio.run's loop is
+    # watched on a real node exactly as under pytest (no-op unless
+    # PBFT_SANITIZE=loop is set)
+    from . import sanitize
+
+    sanitize.install()
     asyncio.run(run_node(args))
 
 

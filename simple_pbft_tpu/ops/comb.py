@@ -436,7 +436,8 @@ def use_accum_impl(name: str) -> None:
     the XLA fori_loop elsewhere (interpret-mode Pallas is far too slow
     for CPU tests)."""
     global ACCUM_IMPL
-    assert name in ("auto", "xla", "pallas"), name
+    if name not in ("auto", "xla", "pallas"):
+        raise ValueError(f"accum impl must be auto|xla|pallas, got {name!r}")
     ACCUM_IMPL = name
 
 

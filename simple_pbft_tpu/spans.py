@@ -51,6 +51,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from . import sanitize
 from .logutil import Histogram
 
 # canonical stage names (keep tools/critical_path.py's grouping in sync)
@@ -87,8 +88,10 @@ class SpanRecorder:
     (telemetry must never take down the node it observes)."""
 
     def __init__(self, ring: int = 4096) -> None:
-        self._lock = threading.Lock()
-        self._sink_lock = threading.Lock()  # serializes file I/O only
+        self._lock = sanitize.wrap_lock(threading.Lock(), "spans.recorder")
+        # serializes file I/O only; same sanitizer group as _lock: the
+        # two must never be held together (sink I/O off the ring lock)
+        self._sink_lock = sanitize.wrap_lock(threading.Lock(), "spans.sink")
         self._ring: deque = deque(maxlen=ring)
         self._hists: Dict[str, Histogram] = {}
         self._sink = None

@@ -51,6 +51,8 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
+from . import sanitize
+
 log = logging.getLogger("pbft.telemetry")
 
 # The snapshot/trace/evidence stability contract (docs/OBSERVABILITY.md):
@@ -306,6 +308,9 @@ class FlightRecorder:
         self._snap_errors = 0
 
     def record_once(self) -> None:
+        # loop-confined by design: snapshot() reads unlocked surfaces
+        # that only the loop thread mutates (sanitizer-asserted)
+        sanitize.check_owner(("flight", id(self)), "FlightRecorder.record_once")
         try:
             snap = self.telemetry.snapshot()
         except Exception:  # a snapshot bug must not kill the timeline
@@ -333,6 +338,7 @@ class FlightRecorder:
             except Exception:  # a dead recorder must not abort shutdown
                 log.exception("flight recorder task failed")
             self._task = None
+        sanitize.release_owner(("flight", id(self)))
         self.record_once()  # final frame: the clean-shutdown state
         self._sink.close()
 
@@ -704,7 +710,8 @@ class StatusServer:
 
     @property
     def bound_port(self) -> int:
-        assert self._server is not None
+        if self._server is None:
+            raise RuntimeError("StatusServer not started")
         return self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:

@@ -2,10 +2,12 @@
 
 from __future__ import annotations
 
-from typing import Iterable, Optional, Protocol
+from typing import Any, Iterable, Mapping, Optional, Protocol, Sequence
 
 
-def update_peer_book(transport, addrs) -> int:
+def update_peer_book(
+    transport: Any, addrs: Mapping[str, Sequence[Any]]
+) -> int:
     """Push ``id -> (host, port)`` entries into every peer book found in
     a transport wrapper chain (ShapedTransport / byzantine wrappers hold
     the socket transport behind ``_inner``). Socket transports route by

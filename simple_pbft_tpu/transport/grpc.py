@@ -139,7 +139,8 @@ class GrpcTransport:
     @property
     def bound_port(self) -> int:
         """Actual listening port (when constructed with port 0)."""
-        assert self._bound_port is not None
+        if self._bound_port is None:
+            raise RuntimeError("transport not started")
         return self._bound_port
 
     # -- inbound --------------------------------------------------------

@@ -146,7 +146,8 @@ class TcpTransport:
     @property
     def bound_port(self) -> int:
         """Actual listening port (when constructed with port 0)."""
-        assert self._server is not None
+        if self._server is None:
+            raise RuntimeError("transport not started")
         return self._server.sockets[0].getsockname()[1]
 
     # -- inbound --------------------------------------------------------

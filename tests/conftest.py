@@ -40,3 +40,39 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running scenario (large committees, storms)"
     )
+    config.addinivalue_line(
+        "markers",
+        "sanitize_allow(kind, ...): violations of these sanitizer kinds "
+        "(loop/locks) are EXPECTED by this test (it deliberately stalls "
+        "a loop or crosses a lock) and do not fail it",
+    )
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizers (ISSUE 8): PBFT_SANITIZE=loop,locks arms them; every
+# violation recorded during a test FAILS that test with the attributed
+# stack. Zero overhead when the env is unset (the fixture yields through).
+# ---------------------------------------------------------------------------
+
+import pytest  # noqa: E402
+
+from simple_pbft_tpu import sanitize  # noqa: E402
+
+sanitize.install()  # no-op unless PBFT_SANITIZE asks for the loop watcher
+
+
+@pytest.fixture(autouse=True)
+def _pbft_sanitizer_gate(request):
+    if not (sanitize.enabled("loop") or sanitize.enabled("locks")):
+        yield
+        return
+    sanitize.take_violations()  # drop anything from a previous test
+    sanitize.reset_owners()  # fresh objects get fresh owner bindings
+    yield
+    viols = sanitize.take_violations()
+    marker = request.node.get_closest_marker("sanitize_allow")
+    if marker is not None:
+        allowed = set(marker.args)
+        viols = [v for v in viols if v["kind"] not in allowed]
+    if viols:
+        pytest.fail(sanitize.format_violations(viols), pytrace=False)

@@ -1,0 +1,3 @@
+# Fixture corpus for tests/test_pbftlint.py: each checker has a minimal
+# positive case (*_pos), a negative twin (*_neg), and where relevant a
+# suppression case. These files are PARSED by pbftlint, never imported.

@@ -1,0 +1,7 @@
+"""PBL005 negative twin: validation raises."""
+
+
+def admit(batch):
+    if not batch:
+        raise ValueError("empty batch")
+    return batch

@@ -1,0 +1,6 @@
+"""PBL005 positive: assert in production control flow."""
+
+
+def admit(batch):
+    assert len(batch) > 0, "empty batch"  # vanishes under python -O
+    return batch

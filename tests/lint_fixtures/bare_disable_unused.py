@@ -1,0 +1,8 @@
+"""PBL000 positive: a bare disable that matches NO finding (dead
+policy) must still flag — an unjustified marker is never a free pass."""
+
+import time  # pbftlint: disable=PBL001
+
+
+def not_even_loop_resident():
+    return time.monotonic()

@@ -1,0 +1,23 @@
+# pbftlint: deterministic-module
+"""PBL002 negative twin: the sanctioned deterministic forms."""
+
+import random
+import time
+import zlib
+
+
+def salt(node_id):
+    return zlib.crc32(node_id.encode())  # seed-independent
+
+
+def jitter(rng: random.Random):
+    return rng.random()  # private seeded RNG instance
+
+
+def stamp():
+    return time.monotonic()  # intervals, not protocol content
+
+
+def walk():
+    for item in sorted({"a", "b", "c"}):  # order fixed before iterating
+        print(item)

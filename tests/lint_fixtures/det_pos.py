@@ -1,0 +1,22 @@
+# pbftlint: deterministic-module
+"""PBL002 positive: every nondeterminism class in a replay module."""
+
+import random
+import time
+
+
+def salt(node_id):
+    return hash(node_id)  # PYTHONHASHSEED-salted (the ShapedTransport bug)
+
+
+def jitter():
+    return random.random()  # shared unseeded global RNG
+
+
+def stamp():
+    return time.time()  # wall clock in protocol content
+
+
+def walk():
+    for item in {"a", "b", "c"}:  # hash-order iteration
+        print(item)

@@ -1,0 +1,7 @@
+"""PBL003 negative twin, origin half."""
+
+WIRE_KINDS = ("request", "prepare", "commit")
+
+# small pure-numeric tuples recur legitimately and must not pair up
+# with drift_neg_b's copy
+RETRY_SCHEDULE = (0, 1, 2)

@@ -1,0 +1,9 @@
+"""PBL003 negative twin: an ALIAS single-sources the table (not a
+display, never flags), and a small numeric tuple is below the
+coincidence threshold."""
+
+from tests.lint_fixtures import drift_neg_a
+
+SHED_KINDS = drift_neg_a.WIRE_KINDS  # alias, not a mirrored literal
+
+RETRY_SCHEDULE = (0, 1, 2)

@@ -1,0 +1,3 @@
+"""PBL003 positive, origin half: a literal kind table."""
+
+WIRE_KINDS = ("request", "prepare", "commit")

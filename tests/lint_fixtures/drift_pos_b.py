@@ -1,0 +1,4 @@
+"""PBL003 positive, mirror half: the same table hand-copied (the
+_DEFERRABLE_KINDS vs SHED_DEFERRABLE precedent)."""
+
+SHED_KINDS = ("request", "prepare", "commit")

@@ -1,0 +1,19 @@
+"""PBL001 negative twin: the same work, correctly off-loaded."""
+
+import asyncio
+import json
+import time
+
+
+def blocking_work():
+    time.sleep(0.1)  # runs on a worker thread: caller off-loads it
+
+
+async def handler(frames):
+    await asyncio.to_thread(blocking_work)
+    if frames:
+        json.loads(frames[0])  # ONE decode per frame is the wire protocol
+
+
+def sync_entry():
+    time.sleep(0.1)  # never reachable from the loop: no caller is async

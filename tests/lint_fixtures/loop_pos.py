@@ -1,0 +1,18 @@
+"""PBL001 positive: blocking work reachable on the event loop."""
+
+import json
+import time
+
+
+async def handler(frames):
+    time.sleep(0.1)  # direct block in a coroutine
+    for f in frames:
+        json.loads(f)  # per-item decode in a loop statement
+
+
+def helper():
+    time.sleep(1)  # blocked, and transitively loop-resident via caller()
+
+
+async def caller():
+    helper()

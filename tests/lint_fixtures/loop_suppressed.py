@@ -1,0 +1,11 @@
+"""PBL001 suppression case: justified disable is honored, bare is not."""
+
+import time
+
+
+async def documented_exception():
+    time.sleep(0.1)  # pbftlint: disable=PBL001 -- fixture: capped, documented
+
+
+async def bare_disable():
+    time.sleep(0.1)  # pbftlint: disable=PBL001

@@ -1,0 +1,8 @@
+# pbftlint: shape-tracked-module
+"""PBL006 positive (unrecorded dispatch): calling a jitted handle with
+no _record_shape in the same body escapes post_warm_compiles."""
+
+
+class Verifier:
+    def dispatch(self, batch):
+        return self._fn(batch)  # no shape recording

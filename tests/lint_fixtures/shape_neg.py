@@ -1,0 +1,14 @@
+# pbftlint: shape-tracked-module
+"""PBL006 negative twin: dispatch routed through shape recording, and
+jit construction inside an opted-in (engine) module."""
+
+import jax
+
+
+class Verifier:
+    def _build(self):
+        return jax.jit(lambda x: x * 2)  # construction allowed here
+
+    def dispatch(self, batch):
+        self._record_shape("verify", len(batch))
+        return self._fn(batch)

@@ -1,0 +1,14 @@
+# pbftlint: shape-tracked-module
+"""PBL006 positive (nested-def boundary): a _record_shape inside a
+nested callback must NOT satisfy the enclosing function's dispatch —
+and the dispatch must be reported exactly once."""
+
+
+class Verifier:
+    def outer(self, batch):
+        out = self._fn(batch)  # dispatch in OUTER body
+
+        def cb(result):
+            self._record_shape("verify", result)  # nested: doesn't count
+
+        return out, cb

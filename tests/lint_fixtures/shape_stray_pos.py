@@ -1,0 +1,8 @@
+"""PBL006 positive (stray construction): jax.jit outside the registered
+engine modules is a new unwarmed dispatch surface by definition."""
+
+import jax
+
+
+def make_kernel():
+    return jax.jit(lambda x: x * 2)

@@ -1,0 +1,7 @@
+# pbftlint: consensus-module
+"""PBL004 positive: unguarded, unaudited telemetry call in a consensus
+path."""
+
+
+def on_commit(tracer, seq):
+    tracer.flush_all(seq)  # not in AUDITED_NO_RAISE, no guard

@@ -1,0 +1,363 @@
+"""pbftlint self-tests (ISSUE 8): each checker fires on its minimal
+positive fixture, stays silent on the negative twin, and the
+suppression/baseline plumbing holds the zero-NEW-findings contract.
+
+Fixture sources live in tests/lint_fixtures/ — they are parsed by the
+linter, never imported."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from tools.pbftlint import core
+from tools.pbftlint.core import LintConfig, run_lint
+
+FIX = "tests/lint_fixtures"
+
+
+def run(*names, baseline=None, **kw):
+    cfg = LintConfig(
+        paths=tuple(f"{FIX}/{n}" for n in names),
+        baseline_path=baseline,
+        **kw,
+    )
+    return run_lint(cfg)
+
+
+def codes(res):
+    return [f.code for f in res["findings"]]
+
+
+# ---------------------------------------------------------------------------
+# PBL001 loop-blocking
+# ---------------------------------------------------------------------------
+
+
+def test_loop_blocking_positive():
+    res = run("loop_pos.py")
+    found = res["findings"]
+    assert codes(res) == ["PBL001"] * 3
+    details = {f.detail for f in found}
+    assert "time.sleep" in details  # direct + transitive both present
+    assert "json.loads" in details  # the per-tick re-decode shape
+    # the transitive case names the loop-resident chain
+    scopes = {f.scope for f in found}
+    assert "helper" in scopes  # sync fn, resident only via async caller()
+
+
+def test_loop_blocking_negative():
+    res = run("loop_neg.py")
+    assert codes(res) == []
+
+
+def test_loop_blocking_suppression():
+    res = run("loop_suppressed.py")
+    # justified disable honored; bare disable converts to PBL000
+    assert codes(res) == ["PBL000"]
+    assert len(res["suppressed"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# PBL002 determinism
+# ---------------------------------------------------------------------------
+
+
+def test_determinism_positive():
+    res = run("det_pos.py")
+    details = {f.detail for f in res["findings"]}
+    assert set(codes(res)) == {"PBL002"}
+    assert details == {
+        "hash()", "random.random", "time.time", "set-iteration"
+    }
+
+
+def test_determinism_negative():
+    res = run("det_neg.py")
+    assert codes(res) == []
+
+
+def test_determinism_scope_is_opt_in():
+    # the same nondeterminism OUTSIDE a deterministic module is fine:
+    # loop_neg.py has no marker and calls time-related functions freely
+    res = run("loop_neg.py")
+    assert "PBL002" not in codes(res)
+
+
+# ---------------------------------------------------------------------------
+# PBL003 drift
+# ---------------------------------------------------------------------------
+
+
+def test_drift_positive():
+    res = run("drift_pos_a.py", "drift_pos_b.py")
+    assert codes(res) == ["PBL003"]
+    f = res["findings"][0]
+    # the MIRROR flags, pointing at the origin (sorted-path order)
+    assert f.path.endswith("drift_pos_b.py")
+    assert "drift_pos_a" in f.detail
+
+
+def test_drift_negative_alias_and_small_numeric():
+    res = run("drift_neg_a.py", "drift_neg_b.py")
+    assert codes(res) == []
+
+
+def test_drift_needs_two_modules():
+    res = run("drift_pos_a.py")
+    assert codes(res) == []
+
+
+# ---------------------------------------------------------------------------
+# PBL004 exception-safety / PBL005 assert ban
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_guard_positive():
+    res = run("telem_pos.py")
+    assert codes(res) == ["PBL004"]
+    assert res["findings"][0].detail == "tracer.flush_all"
+
+
+def test_telemetry_guard_negative():
+    res = run("telem_neg.py")
+    assert codes(res) == []
+
+
+def test_assert_ban_positive_and_negative():
+    assert codes(run("assert_pos.py")) == ["PBL005"]
+    assert codes(run("assert_neg.py")) == []
+
+
+# ---------------------------------------------------------------------------
+# PBL006 shape-stability
+# ---------------------------------------------------------------------------
+
+
+def test_shape_stray_jit_positive():
+    res = run("shape_stray_pos.py")
+    assert codes(res) == ["PBL006"]
+    assert res["findings"][0].detail == "stray-jit:jax.jit"
+
+
+def test_shape_unrecorded_dispatch_positive():
+    res = run("shape_dispatch_pos.py")
+    assert codes(res) == ["PBL006"]
+    assert res["findings"][0].detail == "unrecorded-dispatch:self._fn"
+
+
+def test_shape_negative():
+    res = run("shape_neg.py")
+    assert codes(res) == []
+
+
+def test_shape_nested_record_does_not_satisfy_outer():
+    """A _record_shape in a nested callback must not launder the outer
+    dispatch, and the finding appears exactly once (not re-reported for
+    the nested scope)."""
+    res = run("shape_nested_pos.py")
+    assert codes(res) == ["PBL006"]
+    assert res["findings"][0].scope == "Verifier.outer"
+
+
+# ---------------------------------------------------------------------------
+# baseline + suppression plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_absorbs_known_findings(tmp_path):
+    noisy = run("assert_pos.py")
+    key = noisy["findings"][0].key
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({
+        "accepted": [{"key": key, "why": "fixture: documented invariant"}]
+    }))
+    res = run("assert_pos.py", baseline=str(bl))
+    assert codes(res) == []
+    assert len(res["baselined"]) == 1
+    assert res["errors"] == []
+
+
+def test_baseline_entry_without_why_is_an_error(tmp_path):
+    noisy = run("assert_pos.py")
+    key = noisy["findings"][0].key
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"accepted": [{"key": key, "why": ""}]}))
+    res = run("assert_pos.py", baseline=str(bl))
+    # the why-less entry is rejected: the finding stays NEW and the
+    # format error is reported (CLI exits nonzero on either)
+    assert codes(res) == ["PBL005"]
+    assert any("no why" in e for e in res["errors"])
+
+
+def test_stale_baseline_entries_surface(tmp_path):
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({
+        "accepted": [{"key": "PBL005:gone.py::assert@x", "why": "fixed"}]
+    }))
+    res = run("assert_neg.py", baseline=str(bl))
+    assert res["stale_baseline"] == ["PBL005:gone.py::assert@x"]
+
+
+def test_finding_keys_are_line_stable():
+    """The baseline key must not change when code moves within a file."""
+    res = run("assert_pos.py")
+    f = res["findings"][0]
+    assert str(f.line) not in f.key.split(":", 2)[-1]
+    assert f.key == f"PBL005:{FIX}/assert_pos.py::assert@len(batch) > 0"
+
+
+def test_changed_only_filters_by_git(monkeypatch):
+    monkeypatch.setattr(
+        core, "changed_files", lambda root: [f"{FIX}/assert_pos.py"]
+    )
+    res = run("assert_pos.py", "telem_pos.py", changed_only=True)
+    assert codes(res) == ["PBL005"]  # telem_pos finding filtered out
+
+
+def test_unused_bare_disable_still_flags():
+    """A why-less disable with no matching finding is dead policy, not
+    a free pass — PBL000 sweeps every module."""
+    res = run("bare_disable_unused.py")
+    assert codes(res) == ["PBL000"]
+    assert res["suppressed"] == []  # it suppressed nothing
+
+
+def test_write_baseline_preserves_existing_whys(tmp_path):
+    """--write-baseline must only add TODOs for NEW keys — curated
+    justifications survive the rewrite."""
+    noisy = run("assert_pos.py")
+    key = noisy["findings"][0].key
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({
+        "accepted": [{"key": key, "why": "curated: kernel invariant"}]
+    }))
+    core.write_baseline(str(bl), noisy["findings"])
+    doc = json.loads(bl.read_text())
+    assert doc["accepted"][0]["key"] == key
+    assert doc["accepted"][0]["why"] == "curated: kernel invariant"
+
+
+def test_write_baseline_ignores_changed_filter(tmp_path, monkeypatch):
+    """--write-baseline must capture the FULL scope even with --changed:
+    a filtered write would omit new findings in unchanged files and
+    drop their curation on the rewrite."""
+    monkeypatch.setattr(core, "changed_files", lambda root: [])
+    bl = tmp_path / "baseline.json"
+    rc = core.main(
+        [f"{FIX}/assert_pos.py", "--changed", "--write-baseline",
+         "--baseline", str(bl)]
+    )
+    assert rc == 0
+    doc = json.loads(bl.read_text())
+    assert any(e["key"].startswith("PBL005:") for e in doc["accepted"])
+
+
+def test_cli_exits_nonzero_on_stale_baseline(tmp_path):
+    """The CLI and the CI gate (stale_baseline == []) must agree: a
+    pre-commit run with a stale entry fails, same as CI would."""
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({
+        "accepted": [{"key": "PBL005:gone.py::assert@x", "why": "fixed"}]
+    }))
+    rc = core.main(
+        [f"{FIX}/assert_neg.py", "--baseline", str(bl)]
+    )
+    assert rc == 1
+
+
+def test_changed_files_includes_untracked(tmp_path):
+    """A brand-new unstaged module must appear in --changed scope —
+    that is exactly where new findings are born."""
+    subprocess.run(["git", "init", "-q", str(tmp_path)], check=True)
+    subprocess.run(
+        ["git", "-C", str(tmp_path), "-c", "user.email=t@t",
+         "-c", "user.name=t", "commit", "-q", "--allow-empty",
+         "-m", "seed"],
+        check=True,
+    )
+    (tmp_path / "tracked.py").write_text("x = 1\n")
+    subprocess.run(
+        ["git", "-C", str(tmp_path), "add", "tracked.py"], check=True
+    )
+    subprocess.run(
+        ["git", "-C", str(tmp_path), "-c", "user.email=t@t",
+         "-c", "user.name=t", "commit", "-q", "-m", "one"],
+        check=True,
+    )
+    (tmp_path / "tracked.py").write_text("x = 2\n")  # working-tree edit
+    (tmp_path / "fresh.py").write_text("assert x\n")  # untracked
+    got = core.changed_files(str(tmp_path))
+    assert got == ["fresh.py", "tracked.py"]
+
+
+# ---------------------------------------------------------------------------
+# the repo gate itself
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_clean_against_checked_in_baseline():
+    """Acceptance criterion: `python -m tools.pbftlint --json` exits 0
+    on the repo. Runs in-process (subprocess would re-pay jax import)."""
+    res = run_lint(LintConfig())
+    assert [f.to_doc() for f in res["findings"]] == []
+    assert res["errors"] == []
+    assert res["stale_baseline"] == []
+    assert res["files_analyzed"] > 30
+
+
+def test_checked_in_baseline_every_entry_justified():
+    with open(core.DEFAULT_BASELINE) as fh:
+        doc = json.load(fh)
+    assert doc["accepted"], "baseline exists and is non-trivial"
+    for ent in doc["accepted"]:
+        assert ent.get("why", "").strip(), f"unjustified: {ent.get('key')}"
+
+
+def test_cli_json_shape():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.pbftlint", "--json",
+         f"{FIX}/assert_pos.py", "--no-baseline"],
+        capture_output=True, text=True, cwd=core.REPO_ROOT, timeout=120,
+    )
+    assert out.returncode == 1
+    doc = json.loads(out.stdout)
+    assert doc["findings"][0]["code"] == "PBL005"
+    assert doc["findings"][0]["key"].startswith("PBL005:")
+
+
+def test_cli_exit_zero_on_clean_fixture():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.pbftlint", "--json",
+         f"{FIX}/assert_neg.py", "--no-baseline"],
+        capture_output=True, text=True, cwd=core.REPO_ROOT, timeout=120,
+    )
+    assert out.returncode == 0
+    assert json.loads(out.stdout)["findings"] == []
+
+
+# ---------------------------------------------------------------------------
+# audited-entry existence binding (PBL004's rename tripwire)
+# ---------------------------------------------------------------------------
+
+
+def test_audited_entries_bound_to_real_defs():
+    """Every AUDITED_NO_RAISE target must exist in its owning module —
+    renaming RequestTracer.emit must break the lint, not silently
+    un-protect every call site. The full-repo run above would surface
+    an audited-missing finding; assert the table's targets directly so
+    the failure names the entry."""
+    from tools.pbftlint.checks import exception_safety as es
+
+    mods = {
+        m.path: m
+        for m in core.collect_modules(LintConfig())
+    }
+    for (root, term), (owner, cls, name) in es.AUDITED_NO_RAISE.items():
+        mod = mods.get(owner)
+        assert mod is not None, f"audited owner module missing: {owner}"
+        assert es._def_exists(mod, cls, name), (
+            f"audited entry ({root}.{term}) -> {owner}:{cls}.{name} "
+            "no longer exists; re-audit and update AUDITED_NO_RAISE"
+        )

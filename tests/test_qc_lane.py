@@ -148,9 +148,13 @@ def test_qc_committee_fast_path_bounded_no_wedge(monkeypatch):
         )
         com.clients[0].request_timeout = 60.0
         # service-level warm: covers every bucket a coalesced take can
-        # hit (max_batch), closing the shape set before traffic
-        svc.warm_for_population(
-            [kp.pub for kp in com.keys.values()], max_sweep=8
+        # hit (max_batch), closing the shape set before traffic. Off
+        # the loop — seconds of table building + XLA compiles; the loop
+        # sanitizer (PBFT_SANITIZE=loop) fails this test otherwise
+        await asyncio.to_thread(
+            svc.warm_for_population,
+            [kp.pub for kp in com.keys.values()],
+            max_sweep=8,
         )
         com.start()
         try:

@@ -1,0 +1,360 @@
+"""Runtime sanitizer tests (ISSUE 8): the loop-blocking watcher and the
+lock-discipline checker each prove a true positive AND a true negative,
+and the documented lock-order table stays bound to the code."""
+
+import asyncio
+import os
+import re
+import threading
+import time
+
+import pytest
+
+from simple_pbft_tpu import sanitize
+
+
+@pytest.fixture(autouse=True)
+def _drain():
+    sanitize.take_violations()
+    sanitize.reset_owners()
+    yield
+    sanitize.take_violations()
+    sanitize.reset_owners()
+
+
+# ---------------------------------------------------------------------------
+# loop-blocking watcher
+# ---------------------------------------------------------------------------
+
+
+def _wait_violations(kind, timeout=2.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = sanitize.violations(kind)
+        if v:
+            return v
+        time.sleep(0.02)
+    return sanitize.violations(kind)
+
+
+def _fresh_loop():
+    """Construct the loop DIRECTLY (not via the policy): when these
+    tests themselves run under PBFT_SANITIZE=loop, install() has wrapped
+    policy.new_event_loop and would auto-watch the loop at the default
+    threshold before the test can attach its own fast watcher."""
+    return asyncio.SelectorEventLoop()
+
+
+def test_loop_watcher_true_positive():
+    """A coroutine blocking the loop in time.sleep is caught and the
+    violation attributes the offending frame."""
+    loop = _fresh_loop()
+    try:
+        watch = sanitize.watch_loop(loop, threshold_s=0.05)
+        assert watch is not None  # explicit opt-in works regardless of env
+
+        async def blocker():
+            time.sleep(0.4)  # the bug under test: sync sleep on the loop
+
+        loop.run_until_complete(blocker())
+    finally:
+        loop.close()
+    viols = _wait_violations("loop")
+    assert viols, "stalled loop was not detected"
+    v = viols[0]
+    assert v["stall_ms"] >= 50
+    # attribution: the sampled stack bottoms out in our blocker frame
+    assert any("blocker" in fr for fr in v["stack"]), v["stack"]
+    assert "time.sleep" in v["stack"][-1]
+
+
+def test_loop_watcher_true_negative():
+    """A loop that only awaits never violates: parked-in-selector frames
+    are idle, not blocked — even past the threshold."""
+    loop = _fresh_loop()
+    try:
+        sanitize.watch_loop(loop, threshold_s=0.05)
+
+        async def healthy():
+            for _ in range(4):
+                await asyncio.sleep(0.05)
+
+        loop.run_until_complete(healthy())
+        time.sleep(0.15)  # give the watcher time to (not) fire
+    finally:
+        loop.close()
+    assert sanitize.violations("loop") == []
+
+
+def test_loop_watcher_idempotent_per_loop():
+    loop = _fresh_loop()
+    try:
+        first = sanitize.watch_loop(loop, threshold_s=0.5)
+        second = sanitize.watch_loop(loop, threshold_s=0.5)
+        assert first is not None and second is None
+    finally:
+        loop.close()
+
+
+def test_loop_watcher_releases_id_after_close():
+    """The dedup set must not pin a closed loop's id() forever: a later
+    loop allocated at the recycled address would silently go unwatched
+    — a false negative in the exact tool built to prevent them."""
+    loop = _fresh_loop()
+    sanitize.watch_loop(loop, threshold_s=0.5)
+    loop.close()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        with sanitize._watch_lock:
+            if id(loop) not in sanitize._watched:
+                return
+        time.sleep(0.02)
+    raise AssertionError("closed loop's id never left the watch set")
+
+
+def test_one_violation_per_stall_episode():
+    loop = _fresh_loop()
+    try:
+        sanitize.watch_loop(loop, threshold_s=0.05)
+
+        async def long_block():
+            time.sleep(0.5)  # many watcher periods, ONE episode
+
+        loop.run_until_complete(long_block())
+    finally:
+        loop.close()
+    viols = _wait_violations("loop")
+    assert len(viols) == 1
+
+
+# ---------------------------------------------------------------------------
+# lock discipline
+# ---------------------------------------------------------------------------
+
+
+def _mk(name):
+    return sanitize.wrap_lock(threading.Lock(), name, force=True)
+
+
+def test_lock_rank_violation():
+    lo = _mk("verify_service.cond")  # rank 20
+    hi = _mk("qc.lane.cond")  # rank 30
+    with hi:
+        with lo:  # descending rank: the deadlock-prone order
+            pass
+    viols = sanitize.take_violations()
+    assert any("lock order violation" in v["message"] for v in viols)
+
+
+def test_lock_rank_clean_in_order():
+    lo = _mk("verify_service.cond")
+    hi = _mk("qc.lane.cond")
+    with lo:
+        with hi:
+            pass
+    assert sanitize.take_violations() == []
+
+
+def test_leaf_lock_must_not_nest_outward():
+    leaf = _mk("qc.cache")  # leaf: nothing may be acquired under it
+    other = _mk("qc.lane_registry")  # rank 10 < 90, but leaf rule first
+    with leaf:
+        with other:
+            pass
+    viols = sanitize.take_violations()
+    assert any("LEAF" in v["message"] for v in viols)
+
+
+def test_group_exclusion_both_orders():
+    ring = _mk("spans.recorder")
+    sink = _mk("spans.sink")
+    with ring:
+        with sink:  # ascending rank but same group: still forbidden
+            pass
+    viols = sanitize.take_violations()
+    assert any("group" in v["message"] for v in viols)
+
+
+def test_nonblocking_acquire_exempt():
+    """Trylocks can't deadlock; Condition's ownership probe relies on
+    this exemption."""
+    hi = _mk("qc.lane.cond")
+    lo = _mk("verify_service.cond")
+    with hi:
+        got = lo.acquire(blocking=False)
+        assert got
+        lo.release()
+    assert sanitize.take_violations() == []
+
+
+def test_condition_integration():
+    """A _RankedLock drops into threading.Condition unchanged — the
+    product seams construct Condition(wrap_lock(...))."""
+    cond = threading.Condition(_mk("qc.lane.cond"))
+    with cond:
+        cond.notify_all()
+    assert sanitize.take_violations() == []
+
+
+def test_unknown_lock_name_raises_at_construction():
+    with pytest.raises(KeyError):
+        sanitize.wrap_lock(threading.Lock(), "not.in.the.table", force=True)
+
+
+def test_wrap_lock_is_passthrough_when_disabled(monkeypatch):
+    monkeypatch.delenv("PBFT_SANITIZE", raising=False)
+    raw = threading.Lock()
+    assert sanitize.wrap_lock(raw, "qc.cache") is raw
+
+
+# ---------------------------------------------------------------------------
+# owning-thread annotations
+# ---------------------------------------------------------------------------
+
+
+def test_owner_violation_cross_thread(monkeypatch):
+    monkeypatch.setenv("PBFT_SANITIZE", "locks")
+    sanitize.check_owner(("fixture", 1), "fixture.surface")  # binds here
+
+    t = threading.Thread(
+        target=sanitize.check_owner, args=(("fixture", 1), "fixture.surface")
+    )
+    t.start()
+    t.join()
+    viols = sanitize.take_violations()
+    assert any("owning-thread violation" in v["message"] for v in viols)
+
+
+def test_owner_clean_same_thread(monkeypatch):
+    monkeypatch.setenv("PBFT_SANITIZE", "locks")
+    sanitize.bind_owner(("fixture", 2), "fixture.worker")
+    sanitize.check_owner(("fixture", 2), "fixture.worker")
+    assert sanitize.take_violations() == []
+
+
+def test_owner_rebind_violation(monkeypatch):
+    monkeypatch.setenv("PBFT_SANITIZE", "locks")
+    sanitize.bind_owner(("fixture", 3), "fixture.worker")
+    t = threading.Thread(
+        target=sanitize.bind_owner, args=(("fixture", 3), "fixture.worker")
+    )
+    t.start()
+    t.join()
+    viols = sanitize.take_violations()
+    assert any("owner rebind" in v["message"] for v in viols)
+
+
+def test_release_owner_allows_fresh_bind(monkeypatch):
+    """Teardown releases the binding so a later object at a recycled
+    id() binds fresh from any thread — no spurious rebind violation."""
+    monkeypatch.setenv("PBFT_SANITIZE", "locks")
+    key = ("fixture", 5)
+    sanitize.bind_owner(key, "fixture.worker")
+    sanitize.release_owner(key)
+    t = threading.Thread(
+        target=sanitize.bind_owner, args=(key, "fixture.worker")
+    )
+    t.start()
+    t.join()
+    assert sanitize.take_violations() == []
+
+
+def test_qc_lane_worker_releases_owner_on_close(monkeypatch):
+    """The product seam end-to-end: closing a QcVerifyLane releases its
+    worker binding, so a successor lane at the same id binds clean."""
+    monkeypatch.setenv("PBFT_SANITIZE", "locks")
+    from simple_pbft_tpu.consensus.qc import QcVerifyLane
+
+    lane = QcVerifyLane()
+    # the worker spawns lazily on first submit; start it the same way
+    lane._started = True
+    threading.Thread(
+        target=lane._worker, name="qc-verify-lane", daemon=True
+    ).start()
+    key = ("qc.lane.worker", id(lane))
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        with sanitize._owner_lock:
+            if key in sanitize._owners:
+                break
+        time.sleep(0.01)
+    lane.close()
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        with sanitize._owner_lock:
+            if key not in sanitize._owners:
+                break
+        time.sleep(0.01)
+    with sanitize._owner_lock:
+        assert key not in sanitize._owners
+    assert sanitize.take_violations() == []
+
+
+def test_install_arms_policy_created_loops(monkeypatch):
+    """PBFT_SANITIZE=loop must work OUTSIDE pytest too: install() (run
+    by node.main before asyncio.run) wraps the policy so every new loop
+    is watched."""
+    monkeypatch.setenv("PBFT_SANITIZE", "loop")
+    pol = asyncio.get_event_loop_policy()
+    orig = pol.new_event_loop
+    monkeypatch.setattr(sanitize, "_installed", False)
+    try:
+        sanitize.install()
+        loop = asyncio.new_event_loop()
+        try:
+            with sanitize._watch_lock:
+                assert id(loop) in sanitize._watched
+        finally:
+            loop.close()
+    finally:
+        pol.new_event_loop = orig
+
+
+def test_owner_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv("PBFT_SANITIZE", raising=False)
+    sanitize.bind_owner(("fixture", 4), "fixture.worker")
+    t = threading.Thread(
+        target=sanitize.check_owner, args=(("fixture", 4), "fixture.worker")
+    )
+    t.start()
+    t.join()
+    assert sanitize.take_violations() == []
+
+
+# ---------------------------------------------------------------------------
+# documentation binding + report format
+# ---------------------------------------------------------------------------
+
+
+def test_lock_table_matches_docs():
+    """docs/STATIC_ANALYSIS.md's lock-order table and LOCK_RANKS are the
+    same table — drift in either direction fails here."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "docs", "STATIC_ANALYSIS.md")) as fh:
+        text = fh.read()
+    rows = re.findall(
+        r"\|\s*`([\w.]+)`\s*\|\s*(\d+)\s*\|\s*(yes|—)\s*\|\s*([\w-]+|—)\s*\|",
+        text,
+    )
+    documented = {
+        name: (int(rank), leaf == "yes", None if group == "—" else group)
+        for name, rank, leaf, group in rows
+    }
+    coded = {
+        name: (
+            spec["rank"],
+            bool(spec.get("leaf")),
+            spec.get("group"),
+        )
+        for name, spec in sanitize.LOCK_RANKS.items()
+    }
+    assert documented == coded
+
+
+def test_format_violations_carries_stack():
+    sanitize._record(
+        "locks", message="fixture violation", stack=["a.py:1 in f: x()"]
+    )
+    out = sanitize.format_violations(sanitize.take_violations())
+    assert "fixture violation" in out
+    assert "a.py:1 in f" in out

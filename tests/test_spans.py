@@ -388,6 +388,9 @@ def test_final_dump_path_writes_autopsy(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+# the stall is the test subject: the loop sanitizer would (correctly)
+# attribute it to this test — declared, not suppressed
+@pytest.mark.sanitize_allow("loop")
 def test_loop_lag_gauge_sees_a_blocked_loop():
     async def scenario():
         g = LoopLagGauge(interval=0.05)

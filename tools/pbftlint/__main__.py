@@ -1,0 +1,27 @@
+"""CLI entry: ``python -m tools.pbftlint [--json] [--changed] [paths]``.
+
+Pre-commit hook usage (ISSUE 8 satellite):
+
+    # .git/hooks/pre-commit
+    python -m tools.pbftlint --changed || exit 1
+
+``--changed`` analyzes the full scope (the call graph and the drift
+checker are whole-program) but reports only findings in files the
+working tree / index touch — an incremental run that stays honest about
+cross-module effects.
+"""
+
+import os
+import sys
+
+# allow `python tools/pbftlint` and `python -m tools.pbftlint` from the
+# repo root, plus direct invocation from elsewhere
+_here = os.path.dirname(os.path.abspath(__file__))
+_root = os.path.dirname(os.path.dirname(_here))
+if _root not in sys.path:
+    sys.path.insert(0, _root)
+
+from tools.pbftlint.core import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,359 @@
+"""Module-level call graph + event-loop residency classification.
+
+The loop-blocking checker needs to answer one question per function:
+*can this function's body execute on the asyncio event loop thread?*
+The repo's architecture makes this statically decidable to a useful
+approximation:
+
+- **loop-resident roots**: every ``async def`` (coroutines run on the
+  loop between awaits) and every function scheduled onto the loop
+  (``loop.call_soon/call_later/call_at/call_soon_threadsafe``,
+  ``asyncio.ensure_future``, ``create_task`` with a sync callable).
+
+- **propagation**: a *sync* function called from a loop-resident one
+  runs on the loop too — ``await`` only yields at coroutine boundaries,
+  not into plain calls.
+
+- **off-load boundaries stop propagation**: a callable passed to
+  ``asyncio.to_thread``, ``loop.run_in_executor``,
+  ``threading.Thread(target=...)``, or an executor's ``.submit`` runs
+  on a worker thread; the repo's dedicated service seams
+  (``VerifyService.submit``, ``QcVerifyLane.submit`` /
+  ``verify_qc_async``) are themselves non-blocking by contract, so a
+  call *to* them is not an edge into their worker-side bodies.
+
+Resolution is intra-module (bare names, ``self.``/``cls.`` methods of
+the enclosing class) plus imported-module attributes when the imported
+module is inside the analyzed set. Unresolvable calls produce no edge —
+the checker prefers false negatives to noise; the runtime sanitizer
+(``PBFT_SANITIZE=loop``) is the dynamic backstop for what the graph
+cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Module
+
+# sync-callable sinks that hand their argument to the loop => the
+# argument is loop-resident
+LOOP_SCHEDULERS = {
+    "call_soon",
+    "call_later",
+    "call_at",
+    "call_soon_threadsafe",
+    "add_done_callback",
+}
+# callables whose function argument runs OFF the loop
+OFFLOADERS = {"to_thread", "run_in_executor", "submit", "Thread"}
+
+
+@dataclass
+class FuncInfo:
+    mod: str  # module path (repo-relative)
+    qual: str  # qualname within module ("Cls.meth" / "func")
+    node: ast.AST
+    is_async: bool
+    # (dotted call text, ast.Call node) for every call in the body,
+    # excluding calls inside nested function defs (they get their own)
+    calls: List[Tuple[str, ast.Call]] = field(default_factory=list)
+    # dotted names passed as callables to an offloader
+    offloaded_args: Set[str] = field(default_factory=set)
+    # dotted names passed as callables to a loop scheduler
+    scheduled_args: Set[str] = field(default_factory=set)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if isinstance(node, ast.Call):
+        # qc_lane().submit — keep the terminal attrs with a () marker
+        inner = dotted(node.func)
+        if inner is not None:
+            parts.append(inner + "()")
+            return ".".join(reversed(parts))
+    return None
+
+
+class _FuncVisitor(ast.NodeVisitor):
+    """Collect FuncInfo for every def in one module, without descending
+    call collection into nested defs."""
+
+    def __init__(self, modpath: str) -> None:
+        self.modpath = modpath
+        self.stack: List[str] = []
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.imports: Dict[str, str] = {}  # local name -> dotted module
+        self.from_imports: Dict[str, Tuple[str, str]] = {}  # name->(mod,attr)
+
+    # -- imports ----------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.imports[a.asname or a.name.split(".")[0]] = a.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ""
+        for a in node.names:
+            self.from_imports[a.asname or a.name] = (
+                "." * node.level + mod,
+                a.name,
+            )
+
+    # -- defs -------------------------------------------------------------
+    def _handle_def(self, node, is_async: bool) -> None:
+        self.stack.append(node.name)
+        qual = ".".join(self.stack)
+        info = FuncInfo(
+            mod=self.modpath, qual=qual, node=node, is_async=is_async
+        )
+        self.funcs[qual] = info
+        collector = _CallCollector(info)
+        for stmt in node.body:
+            collector.visit(stmt)
+        # recurse for nested defs/classes
+        for stmt in node.body:
+            self.visit(stmt)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._handle_def(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._handle_def(node, is_async=True)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.stack.pop()
+
+
+class _CallCollector(ast.NodeVisitor):
+    """Calls + offload/schedule classifications within ONE def body
+    (stops at nested defs)."""
+
+    def __init__(self, info: FuncInfo) -> None:
+        self.info = info
+
+    def visit_FunctionDef(self, node) -> None:  # don't descend
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted(node.func)
+        if name is not None:
+            self.info.calls.append((name, node))
+            terminal = name.rsplit(".", 1)[-1]
+            cargs: List[ast.AST] = list(node.args)
+            if terminal == "Thread":
+                cargs = [
+                    kw.value for kw in node.keywords if kw.arg == "target"
+                ]
+            elif terminal == "run_in_executor":
+                cargs = list(node.args)[1:2]  # (executor, fn, *args)
+            elif terminal in ("to_thread", "submit"):
+                cargs = list(node.args)[:1]
+            if terminal in OFFLOADERS:
+                for a in cargs:
+                    d = dotted(a)
+                    if d is not None:
+                        self.info.offloaded_args.add(d)
+            elif terminal in LOOP_SCHEDULERS or name in (
+                "asyncio.ensure_future",
+                "ensure_future",
+            ):
+                for a in node.args:
+                    d = dotted(a)
+                    if d is not None:
+                        self.info.scheduled_args.add(d)
+        self.generic_visit(node)
+
+
+@dataclass
+class CallGraph:
+    # (module path, qualname) -> FuncInfo
+    funcs: Dict[Tuple[str, str], FuncInfo]
+    # (module path, qualname) -> why it is loop-resident (chain text)
+    loop_resident: Dict[Tuple[str, str], str]
+    visitors: Dict[str, _FuncVisitor]
+    # method name -> its single definition, when unique across the scope
+    unique_methods: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+
+    def info(self, mod: str, qual: str) -> Optional[FuncInfo]:
+        return self.funcs.get((mod, qual))
+
+
+def _module_name_to_path(mods: List[Module]) -> Dict[str, str]:
+    return {m.modname: m.path for m in mods}
+
+
+# method names too generic for unique-name fallback resolution: an
+# edge guessed wrong here would poison loop-residency propagation
+_COMMON_METHODS = {
+    "submit", "close", "record", "send", "recv", "get", "put", "pop",
+    "append", "start", "stop", "run", "wait", "set", "clear", "update",
+    "write", "read", "snapshot", "warm", "verify", "emit", "items",
+    "keys", "values", "copy", "join", "result", "add", "remove",
+}
+
+
+def _resolve_call(
+    caller: FuncInfo,
+    callee: str,
+    vis: _FuncVisitor,
+    modname_to_path: Dict[str, str],
+    modname: str,
+    unique_methods: Optional[Dict[str, Tuple[str, str]]] = None,
+) -> Optional[Tuple[str, str]]:
+    """Best-effort resolution of a dotted call to (module path, qual)."""
+    parts = callee.split(".")
+    funcs = vis.funcs
+    if len(parts) == 1:
+        name = parts[0]
+        # bare name: module function, or a from-import
+        if name in funcs:
+            return (caller.mod, name)
+        fi = vis.from_imports.get(name)
+        if fi is not None:
+            src, attr = fi
+            tgt = _abs_module(src, modname)
+            path = modname_to_path.get(tgt)
+            if path is not None:
+                return (path, attr)
+        return None
+    head, rest = parts[0], parts[1:]
+    if head in ("self", "cls") and len(rest) == 1:
+        # method of the enclosing class
+        cls = caller.qual.rsplit(".", 1)[0] if "." in caller.qual else None
+        if cls is not None:
+            qual = f"{cls}.{rest[0]}"
+            if qual in funcs:
+                return (caller.mod, qual)
+        return None
+    # imported module attribute: mod.func
+    tgt = vis.imports.get(head)
+    if tgt is None and head in vis.from_imports:
+        src, attr = vis.from_imports[head]
+        tgt = _abs_module(src, modname) + "." + attr
+    if tgt is not None and len(rest) == 1:
+        path = modname_to_path.get(tgt)
+        if path is None:
+            # package-relative import recorded as absolute already?
+            path = modname_to_path.get(_abs_module(tgt, modname))
+        if path is not None:
+            return (path, rest[0])
+    # cross-object fallback: `self.auditor.observe_qc(...)` — the
+    # receiver's class is invisible to a module-level graph, but a
+    # DISTINCTIVE method name defined exactly once in the analyzed scope
+    # identifies its target unambiguously (generic names stay
+    # unresolved: a wrong edge would poison residency propagation)
+    terminal = parts[-1]
+    if (
+        unique_methods is not None
+        and terminal not in _COMMON_METHODS
+        and not terminal.startswith("__")
+    ):
+        hit = unique_methods.get(terminal)
+        if hit is not None:
+            return hit
+    return None
+
+
+def _abs_module(spec: str, modname: str) -> str:
+    """Resolve a (possibly relative) import spec against ``modname``."""
+    if not spec.startswith("."):
+        return spec
+    level = len(spec) - len(spec.lstrip("."))
+    parts = modname.split(".")
+    # level 1 = the module's own package, each extra dot one level up
+    base = parts[:-level] if level <= len(parts) else []
+    tail = spec.lstrip(".")
+    return ".".join(base + ([tail] if tail else []))
+
+
+def build(mods: List[Module]) -> CallGraph:
+    visitors: Dict[str, _FuncVisitor] = {}
+    funcs: Dict[Tuple[str, str], FuncInfo] = {}
+    for m in mods:
+        v = _FuncVisitor(m.path)
+        v.visit(m.tree)
+        visitors[m.path] = v
+        for qual, info in v.funcs.items():
+            funcs[(m.path, qual)] = info
+
+    modname_to_path = _module_name_to_path(mods)
+    path_to_modname = {m.path: m.modname for m in mods}
+
+    # unique-method index for cross-object fallback resolution: only
+    # METHOD names (qual contains a dot) defined exactly once
+    counts: Dict[str, List[Tuple[str, str]]] = {}
+    for (path, qual), info in funcs.items():
+        if "." in qual:
+            counts.setdefault(qual.rsplit(".", 1)[-1], []).append((path, qual))
+    unique_methods = {
+        name: defs[0] for name, defs in counts.items() if len(defs) == 1
+    }
+
+    # roots: async defs + sync callables handed to a loop scheduler
+    resident: Dict[Tuple[str, str], str] = {}
+    worklist: List[Tuple[str, str]] = []
+    for key, info in funcs.items():
+        if info.is_async:
+            resident[key] = f"async def {info.qual}"
+            worklist.append(key)
+    for m in mods:
+        vis = visitors[m.path]
+        for qual, info in vis.funcs.items():
+            for sched in info.scheduled_args:
+                tgt = _resolve_call(
+                    info,
+                    sched,
+                    vis,
+                    modname_to_path,
+                    path_to_modname[m.path],
+                    unique_methods,
+                )
+                if tgt is not None and tgt in funcs and tgt not in resident:
+                    resident[tgt] = (
+                        f"scheduled onto the loop by {info.qual}"
+                    )
+                    worklist.append(tgt)
+
+    # propagate through sync call edges, skipping offloaded callees
+    while worklist:
+        key = worklist.pop()
+        info = funcs[key]
+        vis = visitors[info.mod]
+        modname = path_to_modname[info.mod]
+        for callee, _node in info.calls:
+            if callee in info.offloaded_args:
+                continue
+            tgt = _resolve_call(
+                info, callee, vis, modname_to_path, modname, unique_methods
+            )
+            if tgt is None or tgt not in funcs:
+                continue
+            t_info = funcs[tgt]
+            if t_info.is_async:
+                continue  # its own root already
+            if tgt not in resident:
+                resident[tgt] = f"called from loop-resident {info.qual}"
+                worklist.append(tgt)
+
+    return CallGraph(
+        funcs=funcs,
+        loop_resident=resident,
+        visitors=visitors,
+        unique_methods=unique_methods,
+    )

@@ -1,0 +1,28 @@
+"""Checker registry: every checker module exposes ``check(mods, graph)
+-> List[Finding]``. ``run_all`` builds the shared call graph once and
+fans it out."""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import callgraph
+from ..core import Finding, Module
+
+from . import (  # noqa: E402
+    determinism,
+    drift,
+    exception_safety,
+    loop_blocking,
+    shape_stability,
+)
+
+ALL = (loop_blocking, determinism, drift, exception_safety, shape_stability)
+
+
+def run_all(mods: List[Module]) -> List[Finding]:
+    graph = callgraph.build(mods)
+    out: List[Finding] = []
+    for checker in ALL:
+        out.extend(checker.check(mods, graph))
+    return out

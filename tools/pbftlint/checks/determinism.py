@@ -1,0 +1,166 @@
+"""PBL002 — nondeterminism in replay-deterministic modules.
+
+Historical bug this encodes: ShapedTransport derived its per-node RNG
+salt from builtin ``hash(str)`` — salted per process by PYTHONHASHSEED,
+so the "deterministic" WAN jitter/loss streams differed across runs and
+replay diverged silently (PR 7 review; fixed to crc32).
+
+The replay-deterministic surface (fault schedules, state machines,
+message digests) must not read:
+
+- builtin ``hash()`` — process-salted for str/bytes;
+- wall clock: ``time.time()``, ``datetime.now/utcnow/today`` (monotonic
+  and perf_counter are allowed: they feed timeouts and metrics, never
+  protocol content);
+- module-level ``random.*`` (the shared, unseeded global RNG) — a
+  private seeded ``random.Random(seed)`` is the sanctioned pattern;
+- iteration over a syntactically-evident ``set`` in a ``for`` statement
+  (set literal / ``set()`` call / set comprehension / set union) unless
+  wrapped in ``sorted()`` — hash-order iteration is PYTHONHASHSEED-
+  dependent for strings.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .. import callgraph
+from ..core import Finding, Module
+
+CODE = "PBL002"
+
+# the replay-deterministic modules (repo-relative paths)
+SCOPED = (
+    "simple_pbft_tpu/faults.py",
+    "simple_pbft_tpu/messages.py",
+    "simple_pbft_tpu/consensus/state.py",
+    "simple_pbft_tpu/consensus/statesync.py",
+    "simple_pbft_tpu/consensus/viewchange.py",
+)
+
+WALL_CLOCK = {"time.time", "datetime.now", "datetime.utcnow", "datetime.today"}
+GLOBAL_RANDOM = {
+    "random.random",
+    "random.randint",
+    "random.randrange",
+    "random.choice",
+    "random.choices",
+    "random.shuffle",
+    "random.sample",
+    "random.uniform",
+    "random.gauss",
+    "random.getrandbits",
+    "random.seed",
+}
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        d = callgraph.dotted(node.func)
+        if d in ("set", "frozenset"):
+            return True
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.BitOr, ast.BitAnd, ast.Sub)
+    ):
+        return _is_set_expr(node.left) or _is_set_expr(node.right)
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, mod: Module) -> None:
+        self.mod = mod
+        self.scope: List[str] = []
+        self.findings: List[Finding] = []
+
+    def _qual(self) -> str:
+        return ".".join(self.scope)
+
+    def _add(self, node: ast.AST, detail: str, message: str) -> None:
+        self.findings.append(
+            Finding(
+                code=CODE,
+                path=self.mod.path,
+                line=getattr(node, "lineno", 1),
+                scope=self._qual(),
+                detail=detail,
+                message=message,
+            )
+        )
+
+    def visit_FunctionDef(self, node) -> None:
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = callgraph.dotted(node.func)
+        if name == "hash":
+            self._add(
+                node,
+                "hash()",
+                "builtin hash() is PYTHONHASHSEED-salted for str/bytes — "
+                "replay diverges across processes (the ShapedTransport "
+                "salt bug); use zlib.crc32 or hashlib",
+            )
+        elif name in WALL_CLOCK or (
+            name and name.endswith((".datetime.now", ".datetime.utcnow"))
+        ):
+            self._add(
+                node,
+                name,
+                f"wall clock {name}() in a replay-deterministic module — "
+                "use time.monotonic()/perf_counter() for intervals, or "
+                "thread a timestamp in from the schedule",
+            )
+        elif name in GLOBAL_RANDOM:
+            self._add(
+                node,
+                name,
+                f"{name}() uses the shared unseeded global RNG — "
+                "hold a private random.Random(seed) instead",
+            )
+        self.generic_visit(node)
+
+    def _check_iter(self, it: ast.AST) -> None:
+        if _is_set_expr(it):
+            self._add(
+                it,
+                "set-iteration",
+                "iterating a set: order is hash-salted for strings — "
+                "wrap in sorted() (or iterate a list/tuple/dict)",
+            )
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_iter(node.iter)
+        self.generic_visit(node)
+
+    def visit_AsyncFor(self, node: ast.AsyncFor) -> None:
+        self._check_iter(node.iter)
+        self.generic_visit(node)
+
+
+def check(mods: List[Module], graph: callgraph.CallGraph) -> List[Finding]:
+    out: List[Finding] = []
+    for m in mods:
+        if m.path not in SCOPED and not _opted_in(m):
+            continue
+        v = _Visitor(m)
+        v.visit(m.tree)
+        out.extend(v.findings)
+    return out
+
+
+def _opted_in(m: Module) -> Optional[str]:
+    """Modules outside the built-in scope can opt in with a marker
+    comment (fixture tests use this; future deterministic modules
+    should too): ``# pbftlint: deterministic-module``"""
+    head = "\n".join(m.lines[:30])
+    return "pbftlint: deterministic-module" if (
+        "pbftlint: deterministic-module" in head
+    ) else None

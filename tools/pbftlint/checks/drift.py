@@ -1,0 +1,161 @@
+"""PBL003 — hand-mirrored constant tables drifting apart.
+
+Historical bug this encodes: ``tcp._DEFERRABLE_KINDS`` and
+``replica.SHED_DEFERRABLE`` each hand-listed the deferrable message
+kinds; the two policies drifted until a PR 7 review pass single-sourced
+them behind ``messages.DEFERRABLE``. Same precedent:
+``faults.KIND_REGISTRY`` regenerating its docstring table.
+
+The checker generalizes it: a module-level (or class-level) assignment
+whose value is a *display* (tuple/list/set/frozenset/dict literal) of
+constants appearing with the SAME normalized contents in two or more
+modules is a mirrored table — one of them must become an alias of the
+other (``X = other.Y`` is not a display and never flags). To keep
+coincidences out, a table only participates when it has >= 3 elements
+and either contains a string element or has >= 5 elements (pure small
+numeric tuples like ``(0, 1, 2)`` recur legitimately).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from .. import callgraph
+from ..core import Finding, Module
+
+CODE = "PBL003"
+
+
+def _const_elts(elts) -> Optional[Tuple]:
+    vals = []
+    for e in elts:
+        if isinstance(e, ast.Constant) and not isinstance(e.value, bool):
+            vals.append(e.value)
+        else:
+            return None
+    return tuple(vals)
+
+
+def _normalize(node: ast.AST) -> Optional[Tuple[str, Tuple]]:
+    """(kind, normalized contents) for a constant display, else None.
+    Sets/frozensets normalize order-insensitively; so do dicts (by
+    key): a mirrored table is a mirror even if reordered."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = _const_elts(node.elts)
+        if vals is not None:
+            return ("seq", vals)
+    if isinstance(node, ast.Set):
+        vals = _const_elts(node.elts)
+        if vals is not None:
+            return ("set", tuple(sorted(vals, key=repr)))
+    if isinstance(node, ast.Call):
+        d = callgraph.dotted(node.func)
+        if d in ("set", "frozenset") and len(node.args) == 1 and isinstance(
+            node.args[0], (ast.Tuple, ast.List, ast.Set)
+        ):
+            vals = _const_elts(node.args[0].elts)
+            if vals is not None:
+                return ("set", tuple(sorted(vals, key=repr)))
+    if isinstance(node, ast.Dict):
+        if any(k is None for k in node.keys):
+            return None
+        keys = _const_elts([k for k in node.keys if k is not None])
+        vals = _const_elts(node.values)
+        if keys is not None and vals is not None:
+            items = tuple(sorted(zip(keys, vals), key=lambda kv: repr(kv[0])))
+            return ("dict", items)
+    return None
+
+
+def _eligible(kind: str, vals: Tuple) -> bool:
+    n = len(vals)
+    if n < 3:
+        return False
+    flat = [v for v in (
+        [x for kv in vals for x in kv] if kind == "dict" else vals
+    )]
+    has_str = any(isinstance(v, str) for v in flat)
+    return has_str or n >= 5
+
+
+class _TableVisitor(ast.NodeVisitor):
+    """Module- and class-level constant-display assignments."""
+
+    def __init__(self, mod: Module) -> None:
+        self.mod = mod
+        self.scope: List[str] = []
+        # (kind, contents) -> [(name, line, scope)]
+        self.tables: List[Tuple[Tuple[str, Tuple], str, int, str]] = []
+
+    def visit_FunctionDef(self, node) -> None:
+        pass  # function-local tables are not shared surfaces
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def _target_name(self, tgt: ast.AST) -> Optional[str]:
+        if isinstance(tgt, ast.Name):
+            return tgt.id
+        return None
+
+    def _handle(self, name: Optional[str], value: ast.AST, line: int) -> None:
+        if not name or name == "__all__":
+            return
+        norm = _normalize(value)
+        if norm is None or not _eligible(*norm):
+            return
+        self.tables.append((norm, name, line, ".".join(self.scope)))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if len(node.targets) == 1:
+            self._handle(
+                self._target_name(node.targets[0]), node.value, node.lineno
+            )
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._handle(
+                self._target_name(node.target), node.value, node.lineno
+            )
+
+
+def check(mods: List[Module], graph: callgraph.CallGraph) -> List[Finding]:
+    by_contents: Dict[Tuple[str, Tuple], List[Tuple[str, str, int, str]]] = {}
+    for m in mods:
+        v = _TableVisitor(m)
+        v.visit(m.tree)
+        for norm, name, line, scope in v.tables:
+            by_contents.setdefault(norm, []).append(
+                (m.path, name, line, scope)
+            )
+    out: List[Finding] = []
+    for norm, sites in by_contents.items():
+        paths = {s[0] for s in sites}
+        if len(paths) < 2:
+            continue  # same-module repetition is a different smell
+        sites = sorted(sites)
+        origin = sites[0]
+        for path, name, line, scope in sites[1:]:
+            if path == origin[0]:
+                continue
+            out.append(
+                Finding(
+                    code=CODE,
+                    path=path,
+                    line=line,
+                    scope=scope,
+                    detail=f"mirror-of:{origin[0]}:{origin[1]}",
+                    message=(
+                        f"literal table {name!r} mirrors "
+                        f"{origin[1]!r} in {origin[0]} — single-source it "
+                        "(alias one from the other, the "
+                        "messages.DEFERRABLE precedent)"
+                    ),
+                )
+            )
+    return out

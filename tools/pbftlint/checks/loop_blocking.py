@@ -1,0 +1,163 @@
+"""PBL001 — blocking work reachable on the shared event loop.
+
+Historical bugs this encodes:
+
+- PR 7 second review pass: the TCP reconnect drain re-``json.loads``-ed
+  the whole outbox (pre-prepares carry full blocks) on the shared event
+  loop EVERY backoff tick — fixed by memoizing the deferrable verdict.
+- The r5 qc256 wedge: 25-60 ms BLS pairings riding ``asyncio.to_thread``
+  starved the loop's executor; the fix was a dedicated off-loop lane
+  (consensus/qc.py). A pairing called *directly* on the loop is the
+  same bug without the executor indirection.
+
+Classification comes from the call graph (callgraph.py): a function is
+loop-resident when it is a coroutine, is scheduled onto the loop, or is
+transitively called from one without passing an off-load boundary
+(``asyncio.to_thread`` / ``run_in_executor`` / ``threading.Thread`` /
+executor ``submit``). Within loop-resident functions we flag:
+
+- unconditionally blocking calls: ``time.sleep``, ``subprocess.*``,
+  ``os.system``/``os.popen``, sync sockets, ``urllib.request.urlopen``;
+- native-crypto entry points (ctypes pairings / batched verifies): the
+  ``bls.verify*`` family and ``qc.verify_qc``/``verify_qcs_all`` —
+  these must ride VerifyService, the QcVerifyLane, or a to_thread;
+- ``json.loads``/``json.dumps`` **inside a for/while loop** — the wire
+  codec is JSON, so a single decode on the loop is the protocol; a
+  decode per queued frame per tick is the PR 7 outbox bug shape.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .. import callgraph
+from ..core import Finding, Module
+
+CODE = "PBL001"
+
+# dotted-name suffixes that block the calling thread, always
+BLOCKING = {
+    "time.sleep",
+    "os.system",
+    "os.popen",
+    "os.waitpid",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.Popen",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "urllib.request.urlopen",
+    "requests.get",
+    "requests.post",
+}
+# native crypto entry points: a pairing or batched verify is 25-60 ms
+# native / ~0.8 s pure-python — never on the loop
+BLOCKING_CRYPTO_TERMINALS = {
+    "verify_aggregate",
+    "verify_aggregates_batch",
+    "verify_aggregates_all",
+    "bisect_bad_shares",
+    # the sync Ed25519 surface: a 64-msg batch is ~5-40 ms CPU — fine on
+    # a worker, a stall on the loop (audit.py's envelope re-checks are
+    # the capped, documented exception — baselined, not invisible)
+    "verify_batch",
+    "verify_signed_dicts",
+    "reverify_record",
+}
+BLOCKING_CRYPTO = {
+    "bls.verify",
+    "qc.verify_qc",
+    "qc.verify_qcs_all",
+    "verify_qc",
+    "verify_qcs_all",
+}
+# flagged only when lexically inside a loop statement (the per-tick
+# re-decode shape); one decode per received frame is the wire protocol
+JSON_CODEC = {"json.loads", "json.dumps"}
+
+
+def _in_loop_stmt(node: ast.AST, ancestors) -> bool:
+    return any(isinstance(a, (ast.For, ast.While, ast.AsyncFor)) for a in ancestors)
+
+
+class _AncestorWalk:
+    """Yields (call node, ancestor stack) for calls in one def body,
+    not descending into nested defs."""
+
+    def __init__(self):
+        self.out = []
+
+    def walk(self, node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                continue
+            if isinstance(child, ast.Call):
+                self.out.append((child, list(stack)))
+            stack.append(child)
+            self.walk(child, stack)
+            stack.pop()
+
+
+def _is_blocking(name: str) -> str:
+    """Non-empty reason when the dotted call name is blocking."""
+    terminal = name.rsplit(".", 1)[-1]
+    for b in BLOCKING:
+        if name == b or name.endswith("." + b):
+            return f"blocking call {b}"
+    if terminal in BLOCKING_CRYPTO_TERMINALS:
+        return f"native pairing/batch-verify entry point .{terminal}()"
+    for b in BLOCKING_CRYPTO:
+        if name == b or name.endswith("." + b):
+            return f"pairing-expensive {b}()"
+    return ""
+
+
+def check(mods: List[Module], graph: callgraph.CallGraph) -> List[Finding]:
+    out: List[Finding] = []
+    for m in mods:
+        vis = graph.visitors.get(m.path)
+        if vis is None:
+            continue
+        for qual, info in vis.funcs.items():
+            why = graph.loop_resident.get((m.path, qual))
+            if why is None:
+                continue
+            w = _AncestorWalk()
+            w.walk(info.node, [])
+            for call, ancestors in w.out:
+                name = callgraph.dotted(call.func)
+                if name is None:
+                    continue
+                if name in info.offloaded_args:
+                    continue
+                reason = _is_blocking(name)
+                if not reason and name in JSON_CODEC:
+                    if _in_loop_stmt(call, ancestors):
+                        reason = (
+                            f"{name} inside a loop statement — a decode "
+                            "per queued item per tick (the PR 7 outbox "
+                            "re-decode shape)"
+                        )
+                if reason:
+                    out.append(
+                        Finding(
+                            code=CODE,
+                            path=m.path,
+                            line=call.lineno,
+                            scope=qual,
+                            detail=name,
+                            message=(
+                                f"{reason} on the event loop "
+                                f"({qual} is loop-resident: {why}); "
+                                "off-load via asyncio.to_thread, "
+                                "VerifyService, or the QcVerifyLane"
+                            ),
+                        )
+                    )
+    return out

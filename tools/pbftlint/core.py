@@ -1,0 +1,446 @@
+"""pbftlint core: findings, suppressions, baseline, orchestration.
+
+Design constraints that shaped this module:
+
+- **Zero-new-findings, not zero-findings.** Some findings are accepted
+  facts of the codebase (audit.py's capped loop-synchronous envelope
+  re-checks are *documented* — ISSUE 5's MAX_ENVELOPE_CHECKS bound).
+  Those live in a checked-in baseline (``tools/pbftlint/baseline.json``)
+  where every entry carries a one-line justification; the CI gate fails
+  on any finding NOT in the baseline and on any baseline entry without a
+  ``why``.
+
+- **Line-number-stable keys.** Baselines keyed on line numbers rot on
+  every unrelated edit. A finding's identity is
+  ``code:path:scope:detail`` — the enclosing function/class qualname
+  plus a checker-chosen detail string — so findings survive code motion
+  within a file.
+
+- **Suppressions are in-code and justified.** ``# pbftlint:
+  disable=PBL001 -- why`` on the flagged line (or the line above)
+  suppresses that code there. A disable with no justification text is
+  itself a finding (PBL000), so "just silence it" leaves a mark the
+  gate rejects.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), os.pardir, os.pardir)
+)
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+# default lint scope: the product package. tools/ scripts are offline
+# CLIs (no event loop, no replay contract); tests are exercised code,
+# not shipped code. Explicit path arguments override.
+DEFAULT_PATHS = ("simple_pbft_tpu",)
+
+SUPPRESS_RE = re.compile(
+    r"#\s*pbftlint:\s*disable=([A-Z0-9,]+)(?:\s*(?:--|—)\s*(.*))?"
+)
+
+
+@dataclass
+class Finding:
+    code: str  # PBL00x
+    path: str  # repo-relative, forward slashes
+    line: int
+    scope: str  # enclosing qualname ("" = module level)
+    detail: str  # checker-chosen stable identity detail
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Line-number-free identity used by baseline + suppressions."""
+        return f"{self.code}:{self.path}:{self.scope}:{self.detail}"
+
+    def to_doc(self) -> Dict[str, object]:
+        return {
+            "code": self.code,
+            "path": self.path,
+            "line": self.line,
+            "scope": self.scope,
+            "detail": self.detail,
+            "message": self.message,
+            "key": self.key,
+        }
+
+
+@dataclass
+class Suppression:
+    codes: Tuple[str, ...]
+    line: int
+    why: str
+    used: bool = False
+
+
+@dataclass
+class Module:
+    """One parsed source file plus its lint-relevant side tables."""
+
+    path: str  # repo-relative
+    abspath: str
+    source: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    suppressions: List[Suppression] = field(default_factory=list)
+
+    @property
+    def modname(self) -> str:
+        """Dotted module name relative to the repo root."""
+        p = self.path[:-3] if self.path.endswith(".py") else self.path
+        parts = p.split("/")
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+
+@dataclass
+class LintConfig:
+    paths: Sequence[str] = DEFAULT_PATHS
+    baseline_path: Optional[str] = DEFAULT_BASELINE
+    changed_only: bool = False
+    repo_root: str = REPO_ROOT
+
+
+def _iter_py_files(root: str, rel: str) -> Iterable[str]:
+    ab = os.path.join(root, rel)
+    if os.path.isfile(ab):
+        if ab.endswith(".py"):
+            yield rel.replace(os.sep, "/")
+        return
+    for dirpath, dirnames, filenames in os.walk(ab):
+        dirnames[:] = [
+            d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+        ]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                full = os.path.join(dirpath, fn)
+                yield os.path.relpath(full, root).replace(os.sep, "/")
+
+
+def _parse_suppressions(lines: List[str]) -> List[Suppression]:
+    out = []
+    for i, text in enumerate(lines, start=1):
+        m = SUPPRESS_RE.search(text)
+        if m:
+            codes = tuple(c for c in m.group(1).split(",") if c)
+            why = (m.group(2) or "").strip()
+            out.append(Suppression(codes=codes, line=i, why=why))
+    return out
+
+
+def load_module(repo_root: str, rel: str) -> Optional[Module]:
+    ab = os.path.join(repo_root, rel)
+    try:
+        with open(ab, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        tree = ast.parse(src, filename=rel)
+    except (OSError, SyntaxError):
+        return None
+    lines = src.splitlines()
+    return Module(
+        path=rel,
+        abspath=ab,
+        source=src,
+        tree=tree,
+        lines=lines,
+        suppressions=_parse_suppressions(lines),
+    )
+
+
+def collect_modules(cfg: LintConfig) -> List[Module]:
+    seen = set()
+    mods: List[Module] = []
+    for p in cfg.paths:
+        rel = os.path.relpath(os.path.join(cfg.repo_root, p), cfg.repo_root)
+        for f in _iter_py_files(cfg.repo_root, rel):
+            if f in seen:
+                continue
+            seen.add(f)
+            m = load_module(cfg.repo_root, f)
+            if m is not None:
+                mods.append(m)
+    return mods
+
+
+def changed_files(repo_root: str) -> Optional[List[str]]:
+    """Working-tree + staged + UNTRACKED python files, repo-relative —
+    everything a commit could pick up. ``git diff HEAD`` alone omits
+    brand-new files, which is exactly where new findings are born.
+    None when git is unavailable (callers fall back to a full run)."""
+
+    def _git(*args: str) -> str:
+        return subprocess.run(
+            ["git", *args, "--", "*.py"],
+            cwd=repo_root,
+            capture_output=True,
+            text=True,
+            timeout=30,
+            check=True,
+        ).stdout
+
+    try:
+        out = _git("diff", "--name-only", "HEAD")
+        out += _git("ls-files", "--others", "--exclude-standard")
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return sorted({ln.strip() for ln in out.splitlines() if ln.strip()})
+
+
+# -- suppression / baseline application -------------------------------------
+
+
+def apply_suppressions(
+    mod: Module, findings: List[Finding]
+) -> Tuple[List[Finding], List[Finding]]:
+    """Split ``findings`` into (kept, suppressed). A suppression matches
+    a finding of one of its codes on its own line or the line below it
+    (comment-above style). Unjustified suppressions become PBL000
+    findings in ``kept``."""
+    by_line: Dict[int, List[Suppression]] = {}
+    for s in mod.suppressions:
+        by_line.setdefault(s.line, []).append(s)
+        by_line.setdefault(s.line + 1, []).append(s)
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        hit = None
+        for s in by_line.get(f.line, ()):
+            if f.code in s.codes:
+                hit = s
+                break
+        if hit is None:
+            kept.append(f)
+        else:
+            hit.used = True
+            suppressed.append(f)
+    return kept, suppressed
+
+
+def bare_disable_findings(mod: Module) -> List[Finding]:
+    """PBL000 for EVERY why-less suppression — used or not, findings in
+    the file or not. An unjustified disable that no longer matches
+    anything is dead policy, not a free pass (the docstring contract:
+    'just silence it' always leaves a mark the gate rejects)."""
+    return [
+        Finding(
+            code="PBL000",
+            path=mod.path,
+            line=s.line,
+            scope="",
+            detail=f"bare-disable:{','.join(s.codes)}",
+            message=(
+                "suppression without justification — write "
+                "'# pbftlint: disable=CODE -- one-line why'"
+            ),
+        )
+        for s in mod.suppressions
+        if not s.why
+    ]
+
+
+def load_baseline(path: Optional[str]) -> Tuple[Dict[str, str], List[str]]:
+    """Returns ({finding key -> why}, [format errors])."""
+    if not path or not os.path.exists(path):
+        return {}, []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as e:
+        return {}, [f"baseline unreadable: {e}"]
+    errors = []
+    out: Dict[str, str] = {}
+    for ent in doc.get("accepted", []):
+        key = ent.get("key", "")
+        why = (ent.get("why") or "").strip()
+        if not key:
+            errors.append(f"baseline entry missing key: {ent!r}")
+            continue
+        if not why:
+            errors.append(f"baseline entry for {key} has no why")
+            continue
+        out[key] = why
+    return out, errors
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    # keep every already-justified why — rewriting the file must only
+    # add TODOs for genuinely NEW keys, never clobber curation
+    existing, _ = load_baseline(path)
+    doc = {
+        "comment": (
+            "pbftlint accepted-findings baseline: the gate is "
+            "zero-NEW-findings. Every entry needs a one-line why; "
+            "remove entries as the underlying finding is fixed."
+        ),
+        "accepted": [
+            {
+                "key": f.key,
+                "why": existing.get(f.key, "TODO: justify or fix"),
+                "message": f.message,
+            }
+            for f in sorted(findings, key=lambda f: f.key)
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2)
+        fh.write("\n")
+
+
+# -- orchestration -----------------------------------------------------------
+
+
+def run_lint(cfg: LintConfig) -> Dict[str, object]:
+    """Run every checker over the configured scope. Returns the result
+    doc the CLI renders:  {findings, suppressed, baselined, errors}.
+
+    ``changed_only`` still ANALYZES the full scope (the call graph and
+    the drift checker are whole-program) but only REPORTS findings in
+    files touched per git — the pre-commit-hook shape."""
+    from . import checks
+
+    mods = collect_modules(cfg)
+    changed: Optional[set] = None
+    if cfg.changed_only:
+        ch = changed_files(cfg.repo_root)
+        if ch is not None:
+            changed = set(ch)
+
+    all_kept: List[Finding] = []
+    all_suppressed: List[Finding] = []
+    by_path: Dict[str, List[Finding]] = {}
+    for f in checks.run_all(mods):
+        by_path.setdefault(f.path, []).append(f)
+    mod_by_path = {m.path: m for m in mods}
+    for path, fs in by_path.items():
+        mod = mod_by_path.get(path)
+        if mod is None:
+            all_kept.extend(fs)
+            continue
+        kept, suppressed = apply_suppressions(mod, fs)
+        all_kept.extend(kept)
+        all_suppressed.extend(suppressed)
+    # PBL000 sweeps EVERY module, not just those with findings: a bare
+    # disable in a clean file must still flag
+    for m in mods:
+        all_kept.extend(bare_disable_findings(m))
+
+    baseline, berrors = load_baseline(cfg.baseline_path)
+    new: List[Finding] = []
+    baselined: List[Finding] = []
+    for f in all_kept:
+        if f.key in baseline:
+            baselined.append(f)
+        else:
+            new.append(f)
+    if changed is not None:
+        new = [f for f in new if f.path in changed]
+
+    new.sort(key=lambda f: (f.path, f.line, f.code))
+    return {
+        "findings": new,
+        "suppressed": all_suppressed,
+        "baselined": baselined,
+        "stale_baseline": sorted(
+            set(baseline) - {f.key for f in all_kept}
+        ),
+        "errors": berrors,
+        "files_analyzed": len(mods),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="pbftlint",
+        description=sys.modules["tools.pbftlint"].__doc__
+        if "tools.pbftlint" in sys.modules
+        else "pbftlint",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: product pkg)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument(
+        "--changed",
+        action="store_true",
+        help="report only findings in git-changed files (pre-commit mode)",
+    )
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline (show every finding)",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current findings as the new baseline (then justify!)",
+    )
+    args = ap.parse_args(argv)
+
+    cfg = LintConfig(
+        paths=tuple(args.paths) or DEFAULT_PATHS,
+        baseline_path=None if args.no_baseline else args.baseline,
+        # a baseline write must capture the FULL scope: combined with
+        # --changed it would silently omit new findings in unchanged
+        # files (and drop their curation on the rewrite)
+        changed_only=args.changed and not args.write_baseline,
+    )
+    try:
+        res = run_lint(cfg)
+    except Exception as e:  # internal error: distinct exit code for CI
+        print(f"pbftlint: internal error: {e!r}", file=sys.stderr)
+        return 2
+
+    findings: List[Finding] = res["findings"]  # type: ignore[assignment]
+    if args.write_baseline:
+        write_baseline(args.baseline, findings + res["baselined"])  # type: ignore[operator]
+        print(
+            f"baseline written: {len(findings)} new finding(s) added — "
+            "fill in each entry's why"
+        )
+        return 0
+
+    if args.as_json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_doc() for f in findings],
+                    "suppressed": len(res["suppressed"]),  # type: ignore[arg-type]
+                    "baselined": len(res["baselined"]),  # type: ignore[arg-type]
+                    "stale_baseline": res["stale_baseline"],
+                    "errors": res["errors"],
+                    "files_analyzed": res["files_analyzed"],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f"{f.path}:{f.line}: {f.code} {f.message}")
+        for e in res["errors"]:  # type: ignore[attr-defined]
+            print(f"baseline: {e}", file=sys.stderr)
+        for k in res["stale_baseline"]:  # type: ignore[attr-defined]
+            print(f"stale baseline entry (fixed? remove it): {k}")
+        print(
+            f"pbftlint: {len(findings)} finding(s), "
+            f"{len(res['baselined'])} baselined, "  # type: ignore[arg-type]
+            f"{len(res['suppressed'])} suppressed, "  # type: ignore[arg-type]
+            f"{res['files_analyzed']} files"
+        )
+    # stale entries fail too: the CLI and the CI gate (which asserts
+    # stale_baseline == []) must agree, or the pre-commit hook passes
+    # commits the gate rejects
+    if findings or res["errors"] or res["stale_baseline"]:
+        return 1
+    return 0
