@@ -83,14 +83,19 @@ def _committee_telemetry(com, service=None) -> dict:
     BENCH_*.json cell carries the telemetry that explains it."""
     from collections import defaultdict
 
-    from simple_pbft_tpu.telemetry import SCHEMA_VERSION
+    from simple_pbft_tpu.telemetry import SCHEMA_VERSION, wire_aggregate
+    from simple_pbft_tpu.transport.base import wire_of
 
     agg, tx = defaultdict(int), defaultdict(int)
+    wires = []
     for r in com.replicas:
         for k, v in r.metrics.items():
             agg[k] += v
         for k, v in getattr(r.transport, "metrics", {}).items():
             tx[k] += v
+        w = wire_of(r.transport)
+        if w is not None:
+            wires.append(w.per_kind())
     exec_seqs = sorted(r.executed_seq for r in com.replicas)
     out = {
         "schema": SCHEMA_VERSION,
@@ -101,6 +106,10 @@ def _committee_telemetry(com, service=None) -> dict:
         "views": sorted({r.view for r in com.replicas}),
         "replica_metrics": dict(sorted(agg.items())),
         "transport": dict(sorted(tx.items())),
+        # committee-wide per-kind msgs+bytes (ISSUE 12 wire accounting):
+        # scraped at window start AND end so the record's wire block is a
+        # pure measurement-window delta
+        "wire_per_kind": wire_aggregate(wires),
     }
     if service is not None:
         out["verify"] = service.snapshot()
@@ -608,7 +617,18 @@ async def run_config(
     def pct(p: float) -> float:
         return lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))] if lat_ms else 0.0
 
+    from simple_pbft_tpu.telemetry import (
+        BENCH_SCHEMA_VERSION,
+        wire_delta,
+        wire_per_commit,
+    )
+
     rec = {
+        # the ledger's own schema stamp (ISSUE 12 satellite): the bench
+        # ledger is what tools/bench_gate.py compares, and it had no
+        # version while the telemetry snapshots have carried one since
+        # PR 5 — the gate refuses cross-schema comparisons
+        "schema_version": BENCH_SCHEMA_VERSION,
         "config": name,
         "n": n,
         "qc_mode": qc_mode,
@@ -643,6 +663,25 @@ async def run_config(
     rec.update(shed_info)
     rec.update(verify_stats)
     rec.update(crash_info)
+    # wire accounting (ISSUE 12 tentpole): the measurement window's
+    # per-kind msgs+bytes and the derived per-commit costs — msgs/commit,
+    # bytes/commit, per-phase broadcast amplification (the O(n²) storm,
+    # previously visible only as the reply_fanout scalar, is now a
+    # first-class per-phase number in every record)
+    wire_kinds = wire_delta(
+        telemetry_start.get("wire_per_kind", {}),
+        telemetry_end.get("wire_per_kind", {}),
+    )
+    slots_delta = (
+        telemetry_end.get("exec_seq_max", 0)
+        - telemetry_start.get("exec_seq_max", 0)
+    )
+    rec["wire"] = {
+        "per_kind": wire_kinds,
+        "per_commit": wire_per_commit(
+            wire_kinds, slots_delta, max(1, committed)
+        ),
+    }
     # QC-plane fast path (ISSUE 3): certificate-verify lane occupancy —
     # batch sizes, pairing latency, queue pressure. Present whenever any
     # QC was verified this process (qc_mode configs; None otherwise).

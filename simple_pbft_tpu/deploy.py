@@ -37,7 +37,24 @@ _OPTION_FIELDS = (
     "max_batch",
     "view_timeout",
     "verify_signatures",
+    # identities whose signed __reconfig__ operations are authorized
+    # (JSON list in the document; empty/absent = reconfiguration
+    # disabled). Named explicitly per deployment — unlike
+    # make_test_committee, a real deployment trusts no client by default.
+    "admin_ids",
 )
+
+
+def _cfg_options(options: Dict) -> Dict:
+    out = {k: v for k, v in options.items() if k in _OPTION_FIELDS}
+    if "admin_ids" in out:
+        ids = out["admin_ids"]
+        if isinstance(ids, str):
+            # a bare "c0" would otherwise iterate into ('c', '0') —
+            # silently authorizing nobody and denying the intended admin
+            ids = (ids,)
+        out["admin_ids"] = tuple(str(i) for i in ids)
+    return out
 
 
 @dataclass
@@ -102,7 +119,7 @@ def generate(
         # snapshot): joiners and reconfigurations inherit reachability,
         # not just membership (transport.base.update_peer_book)
         addrs=dict(addresses),
-        **{k: v for k, v in options.items() if k in _OPTION_FIELDS},
+        **_cfg_options(options),
     )
     return Deployment(cfg=cfg, addresses=addresses)
 
@@ -139,7 +156,7 @@ def load(path: str) -> Deployment:
         pubkeys=pubkeys,
         kx_pubkeys=kx_pubkeys,
         addrs=dict(addresses),
-        **{k: v for k, v in options.items() if k in _OPTION_FIELDS},
+        **_cfg_options(options),
     )
     return Deployment(cfg=cfg, addresses=addresses)
 

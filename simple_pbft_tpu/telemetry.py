@@ -52,6 +52,7 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
 from . import sanitize
+from .transport import base as transport_base
 
 log = logging.getLogger("pbft.telemetry")
 
@@ -62,6 +63,161 @@ log = logging.getLogger("pbft.telemetry")
 # this number — it rides every snapshot as BOTH the historical ``schema``
 # key and, since ISSUE 5, the explicit top-level ``schema_version``.
 SCHEMA_VERSION = 1
+
+# The BENCH LEDGER's schema (bench_consensus records, tools/wan_campaign
+# cells — the artifacts tools/bench_gate.py compares): same stability
+# contract as the telemetry schema — additions never bump it, renames/
+# removals/meaning changes do. Every ledger line carries it top-level so
+# the gate can refuse to compare across incompatible record shapes.
+BENCH_SCHEMA_VERSION = 1
+
+# message kind -> protocol phase, for the per-phase wire rollups (the
+# aggregation-overlay baseline: prepare/commit are the O(n²) phases the
+# ROADMAP's Handel-style overlay must collapse to O(log n)). Kinds not
+# listed (unknown/forged) report under "other".
+WIRE_PHASE_OF_KIND = {
+    "request": "request",
+    "reply": "reply",
+    "preprepare": "preprepare",
+    "prepare": "prepare",
+    "commit": "commit",
+    "qc": "commit",
+    "checkpoint": "checkpoint",
+    "viewchange": "viewchange",
+    "newview": "viewchange",
+    "newviewfetch": "viewchange",
+    "staterequest": "repair",
+    "stateresponse": "repair",
+    "statechunkrequest": "repair",
+    "statechunkreply": "repair",
+    "blockfetch": "repair",
+    "blockreply": "repair",
+    "slotfetch": "repair",
+    "configfetch": "repair",
+    "configreply": "repair",
+}
+
+
+def load_bench_ledger(path: str) -> List[Dict[str, Any]]:
+    """Every parseable JSON object line of a bench/campaign ledger
+    (torn final lines from a live writer are skipped). Shared by the
+    ledger tools (bench_gate, campaign_report) so the tolerant-reader
+    semantics cannot drift between them."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as fh:
+        for ln in fh:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                doc = json.loads(ln)
+            except ValueError:
+                continue
+            if isinstance(doc, dict):
+                out.append(doc)
+    return out
+
+
+def ledger_dig(doc: Dict[str, Any], dotted: str) -> Optional[float]:
+    """Dotted-path numeric lookup into a ledger line (``wire.per_commit.
+    total_msgs_per_slot``). None for missing paths and non-numeric
+    values — bools are rejected (True is not 1.0 for gating purposes)."""
+    cur: Any = doc
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return float(cur)
+
+
+def wire_aggregate(per_kind_rows: List[Dict[str, Dict[str, int]]]) -> Dict[str, Dict[str, int]]:
+    """Sum per-kind wire rows (``WireAccounting.per_kind()`` /
+    ``snapshot()["per_kind"]``) across nodes into one committee-wide
+    ``kind -> {sent_msgs, sent_bytes, recv_msgs, recv_bytes, lost_msgs,
+    lost_bytes}`` table."""
+    agg: Dict[str, Dict[str, int]] = {}
+    for rows in per_kind_rows:
+        for kind, row in (rows or {}).items():
+            cell = agg.setdefault(kind, {})
+            for k, v in row.items():
+                cell[k] = cell.get(k, 0) + int(v)
+    return {k: agg[k] for k in sorted(agg)}
+
+
+def wire_delta(start: Dict[str, Dict[str, int]], end: Dict[str, Dict[str, int]]) -> Dict[str, Dict[str, int]]:
+    """end - start per kind per counter (measurement-window accounting;
+    negative deltas clamp to 0 — a restarted node's fresh ledger must
+    not produce nonsense)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for kind, row in end.items():
+        base = start.get(kind, {})
+        d = {k: max(0, int(v) - int(base.get(k, 0))) for k, v in row.items()}
+        if any(d.values()):
+            out[kind] = d
+    return out
+
+
+def wire_per_commit(
+    per_kind: Dict[str, Dict[str, int]], slots: int, requests: int
+) -> Dict[str, Any]:
+    """Derived wire costs: msgs/bytes per committed SLOT (the protocol's
+    O(n²) unit — one slot = one block agreed) and per committed REQUEST
+    (the user-visible unit; requests batch into blocks), per protocol
+    phase and per kind. A phase's ``msgs_per_slot`` IS its broadcast
+    amplification — at n replicas an all-to-all vote phase sits near
+    n*(n-1), which is exactly the curve the aggregation-overlay work
+    must bend (ROADMAP: Handel / aggregated-signature gossip)."""
+    phases: Dict[str, Dict[str, int]] = {}
+    for kind, row in per_kind.items():
+        ph = WIRE_PHASE_OF_KIND.get(kind, "other")
+        cell = phases.setdefault(
+            ph, {"sent_msgs": 0, "sent_bytes": 0, "lost_msgs": 0, "lost_bytes": 0}
+        )
+        cell["sent_msgs"] += row.get("sent_msgs", 0)
+        cell["sent_bytes"] += row.get("sent_bytes", 0)
+        cell["lost_msgs"] += row.get("lost_msgs", 0)
+        cell["lost_bytes"] += row.get("lost_bytes", 0)
+    slots = max(1, int(slots))
+    requests = max(1, int(requests))
+    # per-kind per-commit detail (the acceptance unit: a ledger line
+    # carries per-PHASE and per-KIND costs — "prepare is 12 msgs/slot"
+    # and "qc is 40% of commit-phase bytes" are both one lookup)
+    out_kinds: Dict[str, Any] = {}
+    for kind in sorted(per_kind):
+        row = per_kind[kind]
+        out_kinds[kind] = {
+            "phase": WIRE_PHASE_OF_KIND.get(kind, "other"),
+            "msgs_per_slot": round(row.get("sent_msgs", 0) / slots, 2),
+            "bytes_per_slot": round(row.get("sent_bytes", 0) / slots, 1),
+            "msgs_per_req": round(row.get("sent_msgs", 0) / requests, 2),
+            "bytes_per_req": round(row.get("sent_bytes", 0) / requests, 1),
+        }
+    out_phases: Dict[str, Any] = {}
+    tot_msgs = tot_bytes = 0
+    for ph in sorted(phases):
+        cell = phases[ph]
+        tot_msgs += cell["sent_msgs"]
+        tot_bytes += cell["sent_bytes"]
+        out_phases[ph] = {
+            "msgs_per_slot": round(cell["sent_msgs"] / slots, 2),
+            "bytes_per_slot": round(cell["sent_bytes"] / slots, 1),
+            "msgs_per_req": round(cell["sent_msgs"] / requests, 2),
+            "bytes_per_req": round(cell["sent_bytes"] / requests, 1),
+            "lost_msgs": cell["lost_msgs"],
+            "lost_bytes": cell["lost_bytes"],
+        }
+    return {
+        "slots": slots,
+        "requests": requests,
+        "per_kind": out_kinds,
+        "per_phase": out_phases,
+        "total_msgs_per_slot": round(tot_msgs / slots, 2),
+        "total_bytes_per_slot": round(tot_bytes / slots, 1),
+        "total_msgs_per_req": round(tot_msgs / requests, 2),
+        "total_bytes_per_req": round(tot_bytes / requests, 1),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -121,6 +277,15 @@ def transport_snapshot(transport) -> Dict[str, Any]:
             snap["shaping"] = shaping()
         except Exception:  # noqa: BLE001 — telemetry never raises inward
             pass
+    try:
+        # per-link per-kind msgs+bytes accounting (ISSUE 12): the wire
+        # block every transport flavor now carries — pbft_top's NETIO
+        # column and the campaign/bench wire rollups read this
+        wire = transport_base.wire_of(transport)
+        if wire is not None:
+            snap["wire"] = wire.snapshot()
+    except Exception:  # noqa: BLE001 — telemetry never raises inward
+        pass
     return snap
 
 

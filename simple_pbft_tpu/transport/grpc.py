@@ -30,6 +30,7 @@ from typing import AsyncIterator, Dict, Iterable, Optional, Tuple
 import grpc
 import grpc.aio
 
+from .base import WireAccounting, base_metrics
 from .tcp import MAX_FRAME, OUTBOX_DEPTH, RECV_BUFFER_BYTES
 
 log = logging.getLogger("pbft.grpc")
@@ -85,13 +86,13 @@ class GrpcTransport:
         self._channels: Dict[str, grpc.aio.Channel] = {}
         self._server: Optional[grpc.aio.Server] = None
         self._bound_port: Optional[int] = None
-        self.metrics: Dict[str, int] = {
-            "sent": 0,
-            "recv": 0,
-            "dropped_outbox": 0,
-            "dropped_recv": 0,
-            "reconnects": 0,
-        }
+        # shared schema (transport.base.COUNTER_SCHEMA): frames_dropped/
+        # frames_requeued stay zero here — gRPC owns the stream, so a
+        # frame yielded to a broken stream is retried by wait_for_ready
+        # rather than individually tracked
+        self.metrics: Dict[str, int] = base_metrics()
+        # per-link per-kind msgs+bytes accounting (ISSUE 12)
+        self.wire = WireAccounting(node_id)
 
     # -- lifecycle ------------------------------------------------------
 
@@ -151,13 +152,16 @@ class GrpcTransport:
             async for raw in request_iterator:
                 if not raw or len(raw) + self._recv_bytes > RECV_BUFFER_BYTES:
                     self.metrics["dropped_recv"] += 1
+                    self.wire.account_lost("dropped_recv", raw)
                     continue
                 self.metrics["recv"] += 1
                 try:
                     self._recv_q.put_nowait(raw)
                     self._recv_bytes += len(raw)
+                    self.wire.account_recv(raw)
                 except asyncio.QueueFull:
                     self.metrics["dropped_recv"] += 1
+                    self.wire.account_lost("dropped_recv", raw)
         except asyncio.CancelledError:
             # server.stop(grace=None) at shutdown: end the RPC quietly
             # instead of letting grpc log an unhandled-cancellation error
@@ -195,6 +199,7 @@ class GrpcTransport:
             while True:
                 raw = await q.get()
                 self.metrics["sent"] += 1
+                self.wire.account_send(dest, raw)
                 yield raw
 
         while True:
@@ -216,7 +221,7 @@ class GrpcTransport:
             backoff = min(backoff * 2, 2.0)
             dropped = 0
             while q.qsize() > OUTBOX_DEPTH // 2:
-                q.get_nowait()
+                self.wire.account_lost("dropped_outbox", q.get_nowait())
                 dropped += 1
             self.metrics["dropped_outbox"] += dropped
 
@@ -228,22 +233,30 @@ class GrpcTransport:
             # push _recv_bytes past the cap and starve inbound peer frames
             if len(raw) + self._recv_bytes > RECV_BUFFER_BYTES:
                 self.metrics["dropped_recv"] += 1
+                self.wire.account_lost("dropped_recv", raw)
                 return
             try:
                 self._recv_q.put_nowait(raw)
                 self._recv_bytes += len(raw)
+                self.wire.account_send(dest, raw)
+                self.wire.account_recv(raw)
             except asyncio.QueueFull:
                 self.metrics["dropped_recv"] += 1
+                self.wire.account_lost("dropped_recv", raw)
             return
         if dest not in self.peers:
-            return  # unknown destination: fire-and-forget semantics
+            # unknown destination: fire-and-forget, but accounted
+            self.wire.account_lost("no_route", raw)
+            return
         if len(raw) > MAX_FRAME:
             self.metrics["dropped_outbox"] += 1
+            self.wire.account_lost("dropped_outbox", raw)
             return
         try:
             self._outbox(dest).put_nowait(raw)
         except asyncio.QueueFull:
             self.metrics["dropped_outbox"] += 1
+            self.wire.account_lost("dropped_outbox", raw)
 
     async def broadcast(self, raw: bytes, dests: Iterable[str]) -> None:
         for dest in dests:

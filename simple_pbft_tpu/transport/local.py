@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, Optional, Set, Tuple
 
 from .. import spans
+from .base import WireAccounting, base_metrics
 
 
 @dataclass
@@ -49,6 +50,16 @@ class LocalNetwork:
         self.faults = fault_plan or FaultPlan()
         self.delivered = 0
         self.dropped = 0
+        # one WireAccounting per node id, shared by every endpoint handle
+        # for that node (accounting must survive re-handles) and readable
+        # by _deliver for the receiver-side count at enqueue time
+        self.wire_accts: Dict[str, WireAccounting] = {}
+
+    def wire_for(self, node_id: str) -> WireAccounting:
+        w = self.wire_accts.get(node_id)
+        if w is None:
+            w = self.wire_accts[node_id] = WireAccounting(node_id)
+        return w
 
     def endpoint(self, node_id: str) -> "LocalEndpoint":
         if node_id not in self.queues:
@@ -56,12 +67,21 @@ class LocalNetwork:
         return LocalEndpoint(node_id, self)
 
     async def _deliver(self, src: str, dst: str, raw: bytes) -> None:
+        src_wire = self.wire_accts.get(src)
         q = self.queues.get(dst)
         if q is None:
-            return  # unknown destination: silently dropped (fire-and-forget)
+            # unknown destination: silently dropped (fire-and-forget)
+            if src_wire is not None:
+                src_wire.account_lost("no_route", raw)
+            return
         f = self.faults
         if (src, dst) in f.partitions or f.rng.random() < f.drop_rate:
             self.dropped += 1
+            # FaultPlan drops are network-side: the sender's ledger owns
+            # them (the receiver never saw the frame) — conservation:
+            # attempted = sent + lost, and sent == received
+            if src_wire is not None:
+                src_wire.account_lost("net_dropped", raw)
             return
         copies = 2 if f.rng.random() < f.duplicate_rate else 1
         lo, hi = f.delay_range
@@ -69,6 +89,10 @@ class LocalNetwork:
         # full transport residency (injected fault delay + queue wait +
         # receiver scheduling) — the wire's leg of the critical path
         item = (time.perf_counter(), raw)
+        # classify ONCE per logical send: sender and receiver ledgers
+        # must agree on the kind for per-kind conservation to hold
+        kind = src_wire.kind_of(raw) if src_wire is not None else ""
+        dst_wire = self.wire_accts.get(dst)
         for _ in range(copies):
             delay = f.rng.uniform(lo, hi) if hi > 0 else 0.0
             if delay > 0:
@@ -76,6 +100,13 @@ class LocalNetwork:
             else:
                 q.put_nowait(item)
             self.delivered += 1
+            # accounted at the delivery decision (wire acceptance), not
+            # at dequeue: frames resident in the recv queue at a test's
+            # end must still reconcile; duplicates count per copy
+            if src_wire is not None:
+                src_wire.account_send(dst, raw, kind=kind)
+            if dst_wire is not None:
+                dst_wire.account_recv(raw, kind=kind)
 
 
 class LocalEndpoint:
@@ -85,10 +116,15 @@ class LocalEndpoint:
         self.node_id = node_id
         self.net = net
         self.queue = net.queues[node_id]
-        # same counter surface as the TCP/gRPC transports so the
-        # telemetry plane reads every deployment flavor identically
-        # (drops are network-wide on a LocalNetwork; see net.dropped)
-        self.metrics: Dict[str, int] = {"sent": 0, "recv": 0}
+        # the FULL shared counter schema (transport.base.COUNTER_SCHEMA):
+        # dropped_*/reconnects/frames_* stay zero on a LocalNetwork
+        # (drops are network-wide here; see net.dropped) but the keys
+        # exist, so the telemetry transport block and pbft_top read every
+        # deployment flavor identically
+        self.metrics: Dict[str, int] = base_metrics()
+        # per-link per-kind msgs+bytes accounting, shared across every
+        # endpoint handle for this node id (ISSUE 12)
+        self.wire = net.wire_for(node_id)
 
     async def send(self, dest: str, raw: bytes) -> None:
         self.metrics["sent"] += 1

@@ -25,6 +25,7 @@ import logging
 from typing import Dict, Iterable, Optional, Tuple
 
 from ..messages import DEFERRABLE_KINDS
+from .base import WireAccounting, base_metrics
 
 log = logging.getLogger("pbft.tcp")
 
@@ -106,19 +107,16 @@ class TcpTransport:
         self._sender_tasks: Dict[str, asyncio.Task] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conn_writers: set = set()  # live inbound connections
-        self.metrics: Dict[str, int] = {
-            "sent": 0,
-            "recv": 0,
-            "dropped_outbox": 0,
-            "dropped_recv": 0,
-            "reconnects": 0,
-            # frames that died mid-write (connection failed with the
-            # frame already dequeued) and were lost for good / requeued
-            # once because they were quorum-critical (ISSUE 7 satellite:
-            # these were previously silent — "this one is lost")
-            "frames_dropped": 0,
-            "frames_requeued": 0,
-        }
+        # shared schema (transport.base.COUNTER_SCHEMA): sent/recv,
+        # dropped_outbox/dropped_recv, reconnects, plus frames that died
+        # mid-write (connection failed with the frame already dequeued)
+        # and were lost for good / requeued once because they were
+        # quorum-critical (ISSUE 7 satellite: previously silent)
+        self.metrics: Dict[str, int] = base_metrics()
+        # per-link per-kind msgs+bytes accounting (ISSUE 12): sends are
+        # accounted when the frame is actually WRITTEN to a socket;
+        # overflow/mid-write losses land in named lost buckets
+        self.wire = WireAccounting(node_id)
 
     # -- lifecycle ------------------------------------------------------
 
@@ -165,16 +163,19 @@ class TcpTransport:
                 if size + self._recv_bytes > RECV_BUFFER_BYTES:
                     # drain the bytes but drop the frame: keeps the stream
                     # framed while bounding resident memory
-                    await reader.readexactly(size)
+                    dropped = await reader.readexactly(size)
                     self.metrics["dropped_recv"] += 1
+                    self.wire.account_lost("dropped_recv", dropped)
                     continue
                 raw = await reader.readexactly(size)
                 self.metrics["recv"] += 1
                 try:
                     self._recv_q.put_nowait(raw)
                     self._recv_bytes += len(raw)
+                    self.wire.account_recv(raw)
                 except asyncio.QueueFull:
                     self.metrics["dropped_recv"] += 1
+                    self.wire.account_lost("dropped_recv", raw)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -236,6 +237,7 @@ class TcpTransport:
                         qi = q.get_nowait()
                         if _item_deferrable(qi):
                             dropped += 1
+                            self.wire.account_lost("dropped_outbox", qi[0])
                         else:
                             kept.append(qi)
                     for qi in kept:
@@ -245,6 +247,7 @@ class TcpTransport:
                 writer.write(encode_frame(raw))
                 await writer.drain()
                 self.metrics["sent"] += 1
+                self.wire.account_send(dest, raw)
             except (ConnectionError, OSError):
                 writer = None  # reconnect on next frame
                 requeued = False
@@ -257,6 +260,7 @@ class TcpTransport:
                         pass
                 if not requeued:
                     self.metrics["frames_dropped"] += 1
+                    self.wire.account_lost("frames_dropped", raw)
 
     # -- Transport interface -------------------------------------------
 
@@ -265,15 +269,23 @@ class TcpTransport:
             try:
                 self._recv_q.put_nowait(raw)
                 self._recv_bytes += len(raw)  # recv() decrements for every frame
+                self.wire.account_send(dest, raw)
+                self.wire.account_recv(raw)
             except asyncio.QueueFull:
                 self.metrics["dropped_recv"] += 1
+                self.wire.account_lost("dropped_recv", raw)
             return
         if dest not in self.peers:
-            return  # unknown destination: fire-and-forget semantics
+            # unknown destination: fire-and-forget semantics, but the
+            # bytes are still accounted (a reconfig-removed peer showing
+            # up here is a diagnosable signal, not silence)
+            self.wire.account_lost("no_route", raw)
+            return
         try:
             self._outbox(dest).put_nowait([raw, False, None])
         except asyncio.QueueFull:
             self.metrics["dropped_outbox"] += 1
+            self.wire.account_lost("dropped_outbox", raw)
 
     async def broadcast(self, raw: bytes, dests: Iterable[str]) -> None:
         for dest in dests:

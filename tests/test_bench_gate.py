@@ -1,0 +1,238 @@
+"""bench_gate + wan_campaign analysis units (ISSUE 12): the noise-aware
+regression gate flags a seeded 30% throughput regression, passes an
+unmodified repeat, widens with measured reference noise (MAD), enforces
+hardware-portable absolute floors (the CI canary path), and refuses
+cross-schema comparisons; the campaign's epoch-boundary spike
+measurement is exercised on synthetic slot timelines."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench_gate = _load_tool("bench_gate")
+wan_campaign = _load_tool("wan_campaign")
+campaign_report = _load_tool("campaign_report")
+
+
+def mkline(cell, *, req_s=100.0, p50=40.0, p99=120.0, msgs_slot=41.0,
+           bytes_slot=11000.0, schema=1, **extra):
+    doc = {
+        "schema_version": schema,
+        "bench": "wan_campaign",
+        "cell": cell,
+        "n": 4,
+        "profile": "none",
+        "transport": "tcp",
+        "committed_req_s": req_s,
+        "p50_ms": p50,
+        "p99_ms": p99,
+        "client_timeouts": 0,
+        "wire": {"per_commit": {
+            "total_msgs_per_slot": msgs_slot,
+            "total_bytes_per_slot": bytes_slot,
+            "total_msgs_per_req": msgs_slot / 3,
+            "total_bytes_per_req": bytes_slot / 3,
+        }},
+    }
+    doc.update(extra)
+    return doc
+
+
+def repeats(cell, base=100.0, jitter=(1.0, 0.97, 1.03, 0.99, 1.01), **kw):
+    return [mkline(cell, req_s=base * j, **kw) for j in jitter]
+
+
+class TestGate:
+    def test_unmodified_repeat_passes(self):
+        ref = repeats("c1")
+        fresh = repeats("c1", jitter=(0.98, 1.02, 1.0))
+        rep = bench_gate.run_gate(fresh, ref)
+        assert rep["ok"], rep
+        assert rep["cells_compared"] == ["c1"]
+
+    def test_seeded_30pct_throughput_regression_flags(self):
+        ref = repeats("c1")
+        fresh = repeats("c1", base=70.0, jitter=(1.0, 0.99, 1.01))
+        rep = bench_gate.run_gate(fresh, ref)
+        assert not rep["ok"]
+        metrics = {r["metric"] for r in rep["regressions"]}
+        assert "committed_req_s" in metrics, rep
+
+    def test_latency_regression_flags_and_improvement_does_not(self):
+        ref = repeats("c1")
+        worse = [mkline("c1", p99=300.0)]
+        rep = bench_gate.run_gate(worse, ref)
+        assert {r["metric"] for r in rep["regressions"]} == {"p99_ms"}
+        better = [mkline("c1", req_s=200.0, p50=10.0, p99=30.0)]
+        assert bench_gate.run_gate(better, ref)["ok"]
+
+    def test_wire_cost_regression_is_tighter_than_throughput(self):
+        ref = repeats("c1")
+        # +20% msgs/slot: the wire metrics are deterministic, so the
+        # floor is 15% and this flags even though 20% of throughput
+        # would pass
+        fresh = [mkline("c1", msgs_slot=49.3)]
+        rep = bench_gate.run_gate(fresh, ref)
+        assert {r["metric"] for r in rep["regressions"]} == {
+            "wire.per_commit.total_msgs_per_slot"
+        }
+
+    def test_measured_noise_widens_the_tolerance(self):
+        # the reference itself wobbles ±40%: MAD scaling must not flag a
+        # fresh median well inside that spread
+        ref = repeats("c1", jitter=(1.0, 1.4, 0.6, 1.3, 0.7))
+        fresh = repeats("c1", base=65.0, jitter=(1.0, 1.01, 0.99))
+        rep = bench_gate.run_gate(fresh, ref)
+        assert rep["ok"], rep
+
+    def test_missing_cell_and_schema_mismatch_are_structural_errors(self):
+        ref = repeats("c1") + repeats("c2")
+        rep = bench_gate.run_gate(repeats("c1"), ref)
+        assert not rep["ok"] and any("c2" in e for e in rep["errors"])
+        rep2 = bench_gate.run_gate(
+            [mkline("c1", schema=99)], repeats("c1"))
+        assert any("schema_version" in e for e in rep2["errors"])
+
+    def test_floors_mode_is_absolute_and_skips_relative(self):
+        ref = [mkline("ci", req_s=1000.0, gate_mode="floors",
+                      gate={"min": {"committed_req_s": 5.0},
+                            "max": {"client_timeouts": 0}})]
+        # 95% below the (other-hardware) reference median: floors-only
+        # mode must still pass — it clears the absolute floor
+        assert bench_gate.run_gate([mkline("ci", req_s=50.0)], ref)["ok"]
+        # below the floor: flagged
+        rep = bench_gate.run_gate([mkline("ci", req_s=2.0)], ref)
+        assert not rep["ok"] and rep["regressions"][0]["bound"] == "min=5.0"
+        # ceiling: timeouts above max flag
+        rep2 = bench_gate.run_gate(
+            [mkline("ci", client_timeouts=3)], ref)
+        assert any(r["metric"] == "client_timeouts"
+                   for r in rep2["regressions"])
+
+    def test_canary_floor_raised_10x_fails(self):
+        # the CI canary shape: copy the reference, raise the throughput
+        # floor to 10x the measured fresh value — the gate MUST fail
+        fresh = [mkline("ci", req_s=50.0)]
+        canary = [mkline("ci", req_s=1000.0, gate_mode="floors",
+                         gate={"min": {"committed_req_s": 500.0}})]
+        rep = bench_gate.run_gate(fresh, canary)
+        assert not rep["ok"]
+
+    def test_cli_exit_codes_and_json(self, tmp_path):
+        ref_p, fresh_p = tmp_path / "ref.jsonl", tmp_path / "fresh.jsonl"
+        ref_p.write_text(
+            "\n".join(json.dumps(d) for d in repeats("c1")) + "\n")
+        fresh_p.write_text(json.dumps(mkline("c1")) + "\n")
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "bench_gate.py"),
+             "--fresh", str(fresh_p), "--reference", str(ref_p), "--json"],
+            capture_output=True, text=True, cwd=ROOT)
+        assert out.returncode == 0, out.stderr
+        assert json.loads(out.stdout)["ok"] is True
+        fresh_p.write_text(json.dumps(mkline("c1", req_s=50.0)) + "\n")
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "bench_gate.py"),
+             "--fresh", str(fresh_p), "--reference", str(ref_p), "--json"],
+            capture_output=True, text=True, cwd=ROOT)
+        assert out.returncode == 1
+        assert json.loads(out.stdout)["regressions"]
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "bench_gate.py"),
+             "--fresh", str(fresh_p), "--reference",
+             str(tmp_path / "empty.jsonl"), "--json"],
+            capture_output=True, text=True, cwd=ROOT)
+        assert out.returncode == 2
+
+
+class TestSpikeMeasurement:
+    def test_flat_series_has_zero_width(self):
+        slots = [(float(i), 50.0 + (i % 3)) for i in range(40)]
+        spike = wan_campaign.measure_commit_spike(slots)
+        assert spike["width_s"] == 0.0 and spike["spike_slots"] == 0
+        assert spike["baseline_ms"] == pytest.approx(51.0, abs=1.0)
+
+    def test_epoch_boundary_excursion_width(self):
+        # 0.2 s per slot baseline 50 ms; slots 20-22 spike to 400/900/
+        # 400 ms — the stop-sequencing stall shape
+        slots = []
+        for i in range(40):
+            t = i * 0.2
+            e2e = 50.0
+            if i in (20, 21, 22):
+                e2e = {20: 400.0, 21: 900.0, 22: 400.0}[i]
+            slots.append((t + e2e / 1e3, e2e))
+        spike = wan_campaign.measure_commit_spike(slots)
+        assert spike["spike_slots"] == 3
+        assert spike["peak_ms"] == 900.0
+        # width: first affected slot start (t=4.0) to last end (~4.8)
+        assert 0.5 < spike["width_s"] < 1.5, spike
+        assert spike["baseline_ms"] == 50.0
+
+    def test_empty_series(self):
+        spike = wan_campaign.measure_commit_spike([])
+        assert spike == {"slots": 0, "baseline_ms": 0.0,
+                         "threshold_ms": 0.0, "spike_slots": 0,
+                         "peak_ms": 0.0, "width_s": 0.0}
+
+    def test_slot_series_joins_phase_spans(self):
+        spans = []
+        for seq in (1, 2):
+            for stage, dur in (("phase.prepare", 10.0),
+                               ("phase.commit", 20.0),
+                               ("phase.execute", 1.0)):
+                spans.append({"evt": "span", "stage": stage, "node": "r0",
+                              "view": 0, "seq": seq, "dur_ms": dur,
+                              "t_mono": 100.0 + seq})
+        # incomplete slot (no execute) and foreign node are excluded
+        spans.append({"evt": "span", "stage": "phase.prepare", "node": "r0",
+                      "view": 0, "seq": 3, "dur_ms": 5.0, "t_mono": 104.0})
+        spans.append({"evt": "span", "stage": "phase.execute", "node": "r9",
+                      "view": 0, "seq": 4, "dur_ms": 5.0, "t_mono": 105.0})
+        series = wan_campaign.slot_series(spans, "r0")
+        assert series == [(101.0, 31.0), (102.0, 31.0)]
+
+
+class TestCampaignReport:
+    def test_render_curves_and_reconfig_section(self):
+        cells = [
+            mkline("wan-tcp-n4-none-o16", n=4, profile="none",
+                   critical_path={"decomposition": [
+                       {"pct": 99.0, "shares": {"phase.prepare": 0.7,
+                                                "phase.commit": 0.3}}]}),
+            mkline("wan-tcp-n4-lossy-o16", n=4, profile="lossy",
+                   req_s=60.0, p99=400.0),
+            mkline("wan-tcp-n16-none-o16", n=16, profile="none",
+                   req_s=40.0, msgs_slot=530.0),
+        ]
+        reconf = mkline("wan-tcp-n5-none-o16-reconfig", n=5)
+        reconf["reconfig"] = {
+            "result": "reconfig-staged:epoch=1:activate_at=48",
+            "removed": "r4", "activated": True, "spike_width_s": 0.35,
+            "spike": {"width_s": 0.35, "peak_ms": 348.0,
+                      "baseline_ms": 108.0, "slots": 83,
+                      "spike_slots": 1, "threshold_ms": 325.0},
+        }
+        md = campaign_report.render(cells + [reconf])
+        assert "## Committed req/s — n × profile" in md
+        assert "| 16 |" in md
+        assert "prepare 70%" in md
+        assert "spike width: 0.35 s" in md
+        assert "lossy" in md

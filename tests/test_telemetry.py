@@ -58,7 +58,13 @@ def test_snapshot_schema_on_idle_node():
         for h in ("sweep_ms", "verify_ms", "commit_ms", "sweep_size"):
             assert rep["stats"][h]["p99"] == 0.0
             assert rep["stats"][h]["count"] == 0
-        assert snap["transport"]["metrics"] == {"sent": 0, "recv": 0}
+        # idle transport: the FULL shared counter schema, all zero
+        # (ISSUE 12 satellite: local aligned with tcp/grpc), plus an
+        # empty wire-accounting block
+        from simple_pbft_tpu.transport.base import COUNTER_SCHEMA
+
+        assert snap["transport"]["metrics"] == {k: 0 for k in COUNTER_SCHEMA}
+        assert snap["transport"]["wire"]["sent_msgs"] == 0
         # plain CPU verifier: name only (nothing to overload)
         assert "name" in snap["verify"]
         # the whole snapshot is JSON-serializable (flight recorder / HTTP)

@@ -1,0 +1,252 @@
+#!/usr/bin/env python3
+"""bench_gate: noise-aware perf-regression gate over bench ledger lines.
+
+The repo's perf trajectory (BASELINE.md, bench_results/*.jsonl) was a
+set of prose assertions: nothing compared a fresh run against the
+committed numbers, so a 30% throughput regression would land silently.
+This tool is the gate (ISSUE 12): it compares fresh ledger lines
+(bench_consensus records, tools/wan_campaign cells) against reference
+lines with per-metric DIRECTION and NOISE-AWARE tolerances, and exits
+nonzero when a cell regressed.
+
+Mechanics:
+
+- Lines are grouped into cells by their ``cell`` (campaign) or
+  ``config`` (bench_consensus) key. Multiple lines per cell are REPEATS:
+  the gate compares medians, and the reference repeats' spread sets the
+  tolerance — ``tol = max(rel_floor, mad_z * 1.4826 * MAD / median)``
+  (MAD-scaled: one outlier repeat cannot widen the gate the way a
+  stddev would). A single-line reference falls back to the per-metric
+  relative floor.
+- Direction matters: ``committed_req_s`` only regresses DOWN, ``p99_ms``
+  and the wire per-commit costs only regress UP. Improvements never
+  flag.
+- Hardware-portable mode: a reference line may carry a ``gate`` block —
+  ``{"min": {metric: floor}, "max": {metric: ceiling}}`` — absolute
+  bounds always enforced on the fresh medians. With
+  ``"gate_mode": "floors"`` the relative comparison is skipped for that
+  cell entirely: that is the CI shape, where the checked-in reference
+  was measured on different hardware and only conservative floors are
+  meaningful.
+- Schema-pinned: every line must carry the bench ledger's
+  ``schema_version``; mismatches are structural errors (exit 2), never
+  silent comparisons across incompatible record shapes.
+
+Exit codes: 0 pass, 1 regression(s), 2 structural error (missing cells,
+unreadable ledgers, schema mismatch). ``--json`` emits one document for
+CI. Triage workflow: docs/OBSERVABILITY.md §bench gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simple_pbft_tpu.telemetry import (  # noqa: E402
+    BENCH_SCHEMA_VERSION,
+    ledger_dig as dig,
+    load_bench_ledger as load_ledger,
+)
+
+# metric -> (direction, relative floor). direction +1 = bigger is
+# better (regression = drop), -1 = smaller is better (regression =
+# rise). The floor is the minimum relative change treated as signal —
+# per-metric because the noise profiles differ: wall-clock throughput
+# on a shared host wobbles far more than the deterministic wire costs.
+METRICS: Dict[str, Tuple[int, float]] = {
+    "committed_req_s": (+1, 0.25),
+    "full_run_req_s": (+1, 0.25),
+    "p50_ms": (-1, 0.35),
+    "p99_ms": (-1, 0.50),
+    "wire.per_commit.total_msgs_per_slot": (-1, 0.15),
+    "wire.per_commit.total_bytes_per_slot": (-1, 0.20),
+    "wire.per_commit.total_msgs_per_req": (-1, 0.25),
+    "wire.per_commit.total_bytes_per_req": (-1, 0.30),
+    "reconfig.spike_width_s": (-1, 0.60),
+}
+
+MAD_Z = 4.0  # tolerance = MAD_Z sigma-equivalents of the reference spread
+
+
+def cell_key(doc: Dict[str, Any]) -> Optional[str]:
+    return doc.get("cell") or doc.get("config")
+
+
+def group_cells(lines: List[Dict[str, Any]]) -> Dict[str, List[Dict[str, Any]]]:
+    cells: Dict[str, List[Dict[str, Any]]] = {}
+    for doc in lines:
+        key = cell_key(doc)
+        if key:
+            cells.setdefault(key, []).append(doc)
+    return cells
+
+
+def _median_mad(vals: List[float]) -> Tuple[float, float]:
+    med = statistics.median(vals)
+    mad = statistics.median([abs(v - med) for v in vals]) if len(vals) > 1 else 0.0
+    return med, mad
+
+
+def compare_cell(
+    name: str,
+    fresh: List[Dict[str, Any]],
+    ref: List[Dict[str, Any]],
+    mad_z: float = MAD_Z,
+) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """(regressions, structural_errors) for one cell."""
+    regressions: List[Dict[str, Any]] = []
+    errors: List[str] = []
+    gate = next((d.get("gate") for d in ref if isinstance(d.get("gate"), dict)), {})
+    floors_only = any(d.get("gate_mode") == "floors" for d in ref)
+
+    for metric, (direction, rel_floor) in METRICS.items():
+        ref_vals = [v for v in (dig(d, metric) for d in ref) if v is not None]
+        fresh_vals = [v for v in (dig(d, metric) for d in fresh) if v is not None]
+        if not ref_vals:
+            continue  # the reference never measured this metric here
+        if not fresh_vals:
+            errors.append(f"{name}: metric {metric} present in reference "
+                          f"but missing from the fresh ledger")
+            continue
+        if floors_only:
+            continue
+        ref_med, ref_mad = _median_mad(ref_vals)
+        fresh_med = statistics.median(fresh_vals)
+        if ref_med <= 0:
+            continue  # zero-valued reference: nothing relative to compare
+        tol = max(rel_floor, mad_z * 1.4826 * ref_mad / ref_med)
+        worse = (
+            (ref_med - fresh_med) / ref_med if direction > 0
+            else (fresh_med - ref_med) / ref_med
+        )
+        if worse > tol:
+            regressions.append({
+                "cell": name,
+                "metric": metric,
+                "reference": round(ref_med, 4),
+                "fresh": round(fresh_med, 4),
+                "change": round(-worse if direction > 0 else worse, 4),
+                "tolerance": round(tol, 4),
+                "repeats": {"reference": len(ref_vals), "fresh": len(fresh_vals)},
+            })
+
+    # absolute bounds (hardware-portable): always enforced
+    for bound, cmp_worse in (("min", lambda v, lim: v < lim),
+                             ("max", lambda v, lim: v > lim)):
+        for metric, lim in (gate.get(bound) or {}).items():
+            fresh_vals = [v for v in (dig(d, metric) for d in fresh) if v is not None]
+            if not fresh_vals:
+                errors.append(f"{name}: gated metric {metric} missing from "
+                              f"the fresh ledger")
+                continue
+            fresh_med = statistics.median(fresh_vals)
+            if cmp_worse(fresh_med, float(lim)):
+                regressions.append({
+                    "cell": name,
+                    "metric": metric,
+                    "bound": f"{bound}={lim}",
+                    "fresh": round(fresh_med, 4),
+                    "repeats": {"fresh": len(fresh_vals)},
+                })
+    return regressions, errors
+
+
+def run_gate(
+    fresh_lines: List[Dict[str, Any]],
+    ref_lines: List[Dict[str, Any]],
+    mad_z: float = MAD_Z,
+) -> Dict[str, Any]:
+    errors: List[str] = []
+    for which, lines in (("fresh", fresh_lines), ("reference", ref_lines)):
+        for doc in lines:
+            sv = doc.get("schema_version")
+            if sv != BENCH_SCHEMA_VERSION:
+                errors.append(
+                    f"{which} line {cell_key(doc)!r}: schema_version "
+                    f"{sv!r} != {BENCH_SCHEMA_VERSION} — refusing to "
+                    "compare across ledger schemas"
+                )
+    fresh_cells = group_cells(fresh_lines)
+    ref_cells = group_cells(ref_lines)
+    if not ref_cells:
+        errors.append("reference ledger has no cells")
+    regressions: List[Dict[str, Any]] = []
+    compared = []
+    for name, ref in sorted(ref_cells.items()):
+        fresh = fresh_cells.get(name)
+        if not fresh:
+            errors.append(f"cell {name!r} in reference but not in fresh ledger")
+            continue
+        regs, errs = compare_cell(name, fresh, ref, mad_z=mad_z)
+        regressions.extend(regs)
+        errors.extend(errs)
+        compared.append(name)
+    return {
+        "ok": not regressions and not errors,
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "cells_compared": compared,
+        "cells_fresh_only": sorted(set(fresh_cells) - set(ref_cells)),
+        "regressions": regressions,
+        "errors": errors,
+    }
+
+
+def render(rep: Dict[str, Any]) -> str:
+    lines = [
+        f"bench_gate: {len(rep['cells_compared'])} cells compared, "
+        f"{len(rep['regressions'])} regressions, {len(rep['errors'])} errors"
+    ]
+    for r in rep["regressions"]:
+        if "bound" in r:
+            lines.append(
+                f"  REGRESSION {r['cell']} {r['metric']}: {r['fresh']} "
+                f"violates {r['bound']}"
+            )
+        else:
+            lines.append(
+                f"  REGRESSION {r['cell']} {r['metric']}: "
+                f"{r['reference']} -> {r['fresh']} "
+                f"({r['change'] * 100:+.1f}%, tol ±{r['tolerance'] * 100:.0f}%)"
+            )
+    for e in rep["errors"]:
+        lines.append(f"  ERROR {e}")
+    if rep["ok"]:
+        lines.append("  PASS")
+    return "\n".join(lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="noise-aware bench-ledger regression gate"
+    )
+    ap.add_argument("--fresh", required=True, help="fresh ledger JSONL")
+    ap.add_argument("--reference", required=True, help="reference ledger JSONL")
+    ap.add_argument("--mad-z", type=float, default=MAD_Z,
+                    help="MAD multiplier for the noise tolerance")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON document")
+    args = ap.parse_args()
+    try:
+        fresh = load_ledger(args.fresh)
+        ref = load_ledger(args.reference)
+    except OSError as e:
+        print(f"bench_gate: {e}", file=sys.stderr)
+        sys.exit(2)
+    rep = run_gate(fresh, ref, mad_z=args.mad_z)
+    if args.json:
+        print(json.dumps(rep, sort_keys=True))
+    else:
+        print(render(rep))
+    if rep["errors"]:
+        sys.exit(2)
+    sys.exit(0 if rep["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,200 @@
+#!/usr/bin/env python3
+"""campaign_report: render a WAN campaign ledger as markdown curves.
+
+Turns ``tools/wan_campaign.py`` ledger lines into the report the
+ROADMAP's WAN item asks to read: throughput and latency vs profile vs
+committee size, the per-commit wire costs that motivate the
+aggregation overlay (msgs/slot growing ~n² while useful work stays
+flat), and each cell's dominant-path decomposition
+(tools/critical_path.py shares, embedded in the ledger at run time).
+
+Usage:
+  python tools/campaign_report.py bench_results/wan_campaign_r07.jsonl
+  python tools/campaign_report.py LEDGER --out bench_results/report.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import statistics
+import sys
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simple_pbft_tpu.telemetry import (  # noqa: E402
+    ledger_dig as _metric,
+    load_bench_ledger,
+)
+
+
+def load(path: str) -> List[Dict[str, Any]]:
+    return [
+        doc for doc in load_bench_ledger(path)
+        if doc.get("bench") == "wan_campaign"
+    ]
+
+
+def _dominant(rec: Dict[str, Any], pct: float = 99.0) -> str:
+    dec = (rec.get("critical_path") or {}).get("decomposition") or []
+    for d in dec:
+        if d.get("pct") == pct and d.get("shares"):
+            stage, share = max(d["shares"].items(), key=lambda kv: kv[1])
+            return f"{stage.split('.', 1)[1]} {share * 100:.0f}%"
+    return ""
+
+
+def _curve_table(
+    cells: List[Dict[str, Any]],
+    metric: str,
+    ns: List[int],
+    profiles: List[str],
+    fmt: str = "{:.1f}",
+    scale: float = 1.0,
+) -> List[str]:
+    """One metric as a markdown table: rows = n, columns = profile —
+    the 'curve' view (read a column top to bottom for the n-scaling of
+    one profile; read a row for the WAN penalty at one size). Repeat
+    lines for one (n, profile) render as their MEDIAN (same aggregation
+    as bench_gate) — never silently last-line-wins. ``scale`` divides
+    at RENDER time (bytes -> KB) — records are never mutated."""
+    by: Dict[Any, List[Dict[str, Any]]] = {}
+    for c in cells:
+        by.setdefault((c["n"], c["profile"]), []).append(c)
+    lines = ["| n | " + " | ".join(profiles) + " |",
+             "|---|" + "---|" * len(profiles)]
+    for n in ns:
+        row = [str(n)]
+        for p in profiles:
+            vals = [
+                v for v in (_metric(c, metric) for c in by.get((n, p), []))
+                if v is not None
+            ]
+            row.append(
+                fmt.format(statistics.median(vals) / scale) if vals else "—"
+            )
+        lines.append("| " + " | ".join(row) + " |")
+    return lines
+
+
+def render(lines_in: List[Dict[str, Any]]) -> str:
+    sweep = [c for c in lines_in if not c.get("reconfig")]
+    reconf = [c for c in lines_in if c.get("reconfig")]
+    ns = sorted({c["n"] for c in sweep})
+    profiles = sorted(
+        {c["profile"] for c in sweep},
+        key=lambda p: ("none", "wan3dc", "lossy").index(p)
+        if p in ("none", "wan3dc", "lossy") else 99,
+    )
+    out: List[str] = ["# WAN measurement campaign", ""]
+    if sweep:
+        tr = sorted({c.get("transport", "?") for c in sweep})
+        sec = sorted({c.get("seconds", 0) for c in sweep})
+        out.append(
+            f"{len(sweep)} sweep cells over {tr} "
+            f"(window {sec} s, real multi-process committees); "
+            f"{len(reconf)} reconfiguration cell(s)."
+        )
+        out.append("")
+        # one curve-section per (transport, load) group: the load axis
+        # must never silently collapse into one blended table
+        groups = sorted({
+            (c.get("transport", "?"), c.get("outstanding", 0))
+            for c in sweep
+        })
+        for grp in groups:
+            grp_cells = [
+                c for c in sweep
+                if (c.get("transport", "?"), c.get("outstanding", 0)) == grp
+            ]
+            suffix = (
+                f" — {grp[0]}, outstanding={grp[1]}"
+                if len(groups) > 1 else ""
+            )
+            for title, metric, fmt, scale in (
+                ("Committed req/s", "committed_req_s", "{:.1f}", 1.0),
+                ("p50 latency (ms)", "p50_ms", "{:.0f}", 1.0),
+                ("p99 latency (ms)", "p99_ms", "{:.0f}", 1.0),
+                ("Wire msgs per committed slot",
+                 "wire.per_commit.total_msgs_per_slot", "{:.0f}", 1.0),
+                ("Wire KB per committed slot",
+                 "wire.per_commit.total_bytes_per_slot", "{:.0f}", 1024.0),
+            ):
+                out.append(f"## {title} — n × profile{suffix}")
+                out.append("")
+                out.extend(
+                    _curve_table(grp_cells, metric, ns, profiles, fmt, scale)
+                )
+                out.append("")
+
+        out.append("## Per-cell detail")
+        out.append("")
+        out.append(
+            "| cell | req/s | p50 ms | p99 ms | msgs/slot | KB/slot | "
+            "timeouts | shaped lost | dominant path (p99) |"
+        )
+        out.append("|---|---|---|---|---|---|---|---|---|")
+        for c in sorted(sweep, key=lambda c: (c["n"], c["profile"])):
+            bps = _metric(c, "wire.per_commit.total_bytes_per_slot") or 0.0
+            out.append(
+                f"| {c['cell']} | {c.get('committed_req_s', 0)} "
+                f"| {c.get('p50_ms', 0):.0f} | {c.get('p99_ms', 0):.0f} "
+                f"| {_metric(c, 'wire.per_commit.total_msgs_per_slot') or 0:.0f} "
+                f"| {bps / 1024:.0f} | {c.get('client_timeouts', 0)} "
+                f"| {c.get('shaped_lost', 0)} | {_dominant(c)} |"
+            )
+        out.append("")
+
+    for c in reconf:
+        rc = c["reconfig"]
+        spike = rc.get("spike") or {}
+        out.append("## Reconfiguration under load")
+        out.append("")
+        out.append(
+            f"Cell `{c['cell']}`: removed `{rc.get('removed')}` mid-window "
+            f"(result `{rc.get('result')}`), epoch activated: "
+            f"{rc.get('activated')}."
+        )
+        out.append("")
+        out.append(
+            f"- **Commit-latency spike width: {spike.get('width_s', 0)} s** "
+            f"({spike.get('spike_slots', 0)} slots above "
+            f"{spike.get('threshold_ms', 0)} ms)"
+        )
+        out.append(
+            f"- peak {spike.get('peak_ms', 0)} ms against a "
+            f"{spike.get('baseline_ms', 0)} ms baseline over "
+            f"{spike.get('slots', 0)} measured slots"
+        )
+        out.append(
+            f"- steady-state through the boundary: "
+            f"{c.get('committed_req_s', 0)} req/s, p99 "
+            f"{c.get('p99_ms', 0):.0f} ms, {c.get('client_timeouts', 0)} "
+            f"client timeouts"
+        )
+        out.append("")
+    return "\n".join(out)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="campaign ledger -> markdown")
+    ap.add_argument("ledger", help="wan_campaign JSONL ledger")
+    ap.add_argument("--out", default=None, help="write markdown here")
+    args = ap.parse_args()
+    cells = load(args.ledger)
+    if not cells:
+        print(f"campaign_report: no campaign lines in {args.ledger}",
+              file=sys.stderr)
+        sys.exit(1)
+    md = render(cells)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(md + "\n")
+        print(f"campaign_report: wrote {args.out}", file=sys.stderr)
+    else:
+        print(md)
+
+
+if __name__ == "__main__":
+    main()

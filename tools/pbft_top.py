@@ -36,8 +36,32 @@ from typing import Dict, List, Optional, Tuple
 COLUMNS = (
     "NODE", "SRC", "VIEW", "ROLE", "EXEC", "STABLE", "CAGE", "BACKLOG",
     "VQ", "QCQ", "QCB", "PAIRms", "SHED", "DEG", "QUAR", "REJ", "WDOG",
-    "AUD", "NET", "RTTms", "LAGms", "REQ/s",
+    "AUD", "NET", "NETIO", "RTTms", "LAGms", "REQ/s",
 )
+
+
+def _fmt_kib(b: float) -> str:
+    return f"{b / 1024:.0f}K" if b < 10 * 1024 * 1024 else f"{b / (1024 * 1024):.1f}M"
+
+
+def netio_cell(snap: dict, prev: Optional[dict], dt: float) -> str:
+    """NETIO: wire-accounting volume (ISSUE 12) — ``msgs/s KiB/s``
+    (sent+recv) between refreshes in the live loop, or cumulative
+    ``msgs KiB`` totals post-mortem / on the first frame. Blank when the
+    node's transport carries no wire ledger (pre-accounting flight
+    files)."""
+    wire = (snap.get("transport") or {}).get("wire") or {}
+    if not wire:
+        return ""
+    msgs = wire.get("sent_msgs", 0) + wire.get("recv_msgs", 0)
+    byts = wire.get("sent_bytes", 0) + wire.get("recv_bytes", 0)
+    pwire = ((prev or {}).get("transport") or {}).get("wire") or {}
+    if pwire and dt > 0:
+        dm = msgs - (pwire.get("sent_msgs", 0) + pwire.get("recv_msgs", 0))
+        db = byts - (pwire.get("sent_bytes", 0) + pwire.get("recv_bytes", 0))
+        if dm >= 0 and db >= 0:
+            return f"{dm / dt:.0f}/s {_fmt_kib(db / dt)}/s"
+    return f"{msgs} {_fmt_kib(byts)}"
 
 
 def net_cell(snap: dict) -> str:
@@ -213,6 +237,7 @@ def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
         str(ver.get("watchdog_failovers", "")),
         aud_cell,
         net_cell(snap),
+        netio_cell(snap, prev, dt),
         (f"{ver['rtt_ms_ema']:.0f}" if "rtt_ms_ema" in ver else ""),
         (f"{lag['ema_ms']:.1f}" if "ema_ms" in lag else ""),
         rate,

@@ -1,0 +1,606 @@
+#!/usr/bin/env python3
+"""wan_campaign: the WAN measurement campaign driver (ISSUE 12).
+
+PR 7 built the survival mechanisms (shaped links, chunked state
+transfer, live reconfiguration); this tool produces the NUMBERS the
+ROADMAP said were missing: throughput/latency-vs-profile curves over
+REAL multi-process committees on real tcp/grpc sockets, per-phase
+per-kind wire costs per commit (the aggregation-overlay baseline), and
+the reconfiguration-under-load cost — the epoch-boundary commit-latency
+spike width — as a first-class benched number.
+
+Each cell of the sweep (n x WAN profile x load):
+
+1. generates a fresh deployment (simple_pbft_tpu/deploy.py) on its own
+   port range;
+2. spawns one ``python -m simple_pbft_tpu.node`` OS process per replica
+   (``--wan-profile`` wraps the socket transport in the deterministic
+   link shaper, exactly like a production rehearsal);
+3. drives closed-loop load from in-process clients over the same wire
+   transport, scrapes every replica's /metrics.json at the window's
+   start and end, and derives the cell's wire block from the
+   measurement-window delta;
+4. appends ONE JSON line to the campaign ledger — schema-stamped,
+   gate-comparable (tools/bench_gate.py), renderable
+   (tools/campaign_report.py).
+
+The reconfiguration cell submits an admin-signed ``__reconfig__``
+remove under load, waits for the epoch to activate at the checkpoint
+boundary, and measures the commit-latency spike from the surviving
+primary's span timeline (``<id>.spans.jsonl``) — width, peak, baseline.
+
+Usage:
+  python tools/wan_campaign.py --out bench_results/wan_campaign_r07.jsonl \
+      --ns 4,16,32,64 --profiles none,wan3dc,lossy --seconds 8
+  python tools/wan_campaign.py --ns 4 --profiles none,lossy --seconds 3 \
+      --no-reconfig-cell --out /tmp/micro.jsonl        # the CI smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import glob
+import json
+import os
+import shutil
+import signal
+import statistics
+import subprocess
+import sys
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_TOOLS))
+sys.path.insert(0, _TOOLS)
+
+import critical_path  # noqa: E402  (tools/critical_path.py)
+
+from simple_pbft_tpu import deploy  # noqa: E402
+from simple_pbft_tpu.telemetry import (  # noqa: E402
+    BENCH_SCHEMA_VERSION,
+    wire_aggregate,
+    wire_delta,
+    wire_per_commit,
+)
+
+NODE_BOOT_TIMEOUT_S = 180.0  # n processes on a small host boot serially
+WARMUP_TIMEOUT_S = 120.0
+
+
+# ---------------------------------------------------------------------------
+# reconfiguration spike measurement (pure functions — unit-tested)
+# ---------------------------------------------------------------------------
+
+
+def slot_series(spans: List[dict], node: str) -> List[Tuple[float, float]]:
+    """One node's commit timeline from its phase.* spans: sorted
+    ``(t_end_mono_s, e2e_ms)`` per completed slot (same join rule as
+    critical_path._slots, plus the end timestamp the width needs)."""
+    acc: Dict[Tuple, Dict[str, float]] = {}
+    ends: Dict[Tuple, float] = {}
+    for s in spans:
+        if s.get("node") != node or "seq" not in s:
+            continue
+        if s["stage"] not in critical_path.PHASE_STAGES:
+            continue
+        key = (s.get("view"), s["seq"])
+        acc.setdefault(key, {}).setdefault(s["stage"], float(s["dur_ms"]))
+        if s["stage"] == "phase.execute":
+            ends.setdefault(key, float(s.get("t_mono", 0.0)))
+    out = []
+    for key, stages in acc.items():
+        if "phase.execute" not in stages:
+            continue
+        out.append((ends.get(key, 0.0), sum(stages.values())))
+    out.sort()
+    return out
+
+
+def measure_commit_spike(
+    slots: List[Tuple[float, float]],
+    threshold_factor: float = 3.0,
+    min_excess_ms: float = 50.0,
+) -> Dict[str, Any]:
+    """The epoch-boundary (or any) commit-latency excursion in one
+    node's slot timeline: baseline = median slot e2e; a slot is IN the
+    spike when its e2e exceeds ``max(threshold_factor * baseline,
+    baseline + min_excess_ms)``; the spike is the maximal contiguous
+    run of such slots and its width is the wall-clock span of that run
+    (first affected slot's start to last affected slot's end). Width 0
+    = no measurable excursion (the reconfiguration was free)."""
+    if not slots:
+        return {"slots": 0, "baseline_ms": 0.0, "threshold_ms": 0.0,
+                "spike_slots": 0, "peak_ms": 0.0, "width_s": 0.0}
+    lats = [e for _, e in slots]
+    baseline = statistics.median(lats)
+    threshold = max(threshold_factor * baseline, baseline + min_excess_ms)
+    best: Tuple[int, int] = (0, -1)  # [start, end] inclusive, empty
+    cur_start = None
+    for i, (_, e2e) in enumerate(slots):
+        if e2e > threshold:
+            if cur_start is None:
+                cur_start = i
+        elif cur_start is not None:
+            if i - cur_start > best[1] - best[0] + 1:
+                best = (cur_start, i - 1)
+            cur_start = None
+    if cur_start is not None and len(slots) - cur_start > best[1] - best[0] + 1:
+        best = (cur_start, len(slots) - 1)
+    if best[1] < best[0]:
+        return {"slots": len(slots), "baseline_ms": round(baseline, 2),
+                "threshold_ms": round(threshold, 2), "spike_slots": 0,
+                "peak_ms": round(max(lats), 2), "width_s": 0.0}
+    run = slots[best[0]: best[1] + 1]
+    # width: from the first affected slot's START (end - duration) to
+    # the last affected slot's end — the window in which commit latency
+    # was visibly disturbed
+    t_start = run[0][0] - run[0][1] / 1e3
+    t_end = run[-1][0]
+    return {
+        "slots": len(slots),
+        "baseline_ms": round(baseline, 2),
+        "threshold_ms": round(threshold, 2),
+        "spike_slots": len(run),
+        "peak_ms": round(max(e for _, e in run), 2),
+        "width_s": round(max(0.0, t_end - t_start), 3),
+    }
+
+
+def reconfig_spike_from_spans(log_dir: str, node: str = "r0") -> Dict[str, Any]:
+    spans = critical_path.load_spans(
+        sorted(glob.glob(os.path.join(log_dir, f"{node}.spans.jsonl")))
+    )
+    return measure_commit_spike(slot_series(spans, node))
+
+
+# ---------------------------------------------------------------------------
+# cell runner
+# ---------------------------------------------------------------------------
+
+
+def _scrape(hostport: str, timeout: float = 5.0) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen(
+            f"http://{hostport}/metrics.json", timeout=timeout
+        ) as resp:
+            return json.loads(resp.read())
+    except Exception:
+        return None
+
+
+async def _scrape_all(endpoints: Dict[str, str]) -> Dict[str, dict]:
+    # concurrent: a window-edge scrape must be one timeout wide, not n —
+    # a single hung node serially would smear the "edge" across seconds
+    rids = list(endpoints)
+    snaps = await asyncio.gather(
+        *(asyncio.to_thread(_scrape, endpoints[rid]) for rid in rids)
+    )
+    return {rid: s for rid, s in zip(rids, snaps) if s is not None}
+
+
+async def _pump(client, stop_at: float, latencies: List, errors: List) -> None:
+    i = 0
+    retries = max(3, client.retries_for_patience(45.0))
+    while time.perf_counter() < stop_at:
+        t0 = time.perf_counter()
+        try:
+            await client.submit(
+                f"put w{id(client) % 997}_{i % 64} {i}", retries=retries
+            )
+            latencies.append((time.perf_counter(), time.perf_counter() - t0))
+        except Exception:
+            errors.append(1)
+        i += 1
+
+
+def _wire_rows(snaps: Dict[str, dict]) -> List[Dict[str, Dict[str, int]]]:
+    return [
+        ((s.get("transport") or {}).get("wire") or {}).get("per_kind") or {}
+        for s in snaps.values()
+    ]
+
+
+async def run_cell(
+    *,
+    name: str,
+    n: int,
+    profile: str,
+    transport: str,
+    seconds: float,
+    clients: int,
+    outstanding: int,
+    work_dir: str,
+    base_port: int,
+    verifier: str,
+    python: str,
+    reconfig: bool = False,
+    checkpoint_interval: int = 32,
+    view_timeout: float = 30.0,
+    keep_dir: bool = False,
+) -> Dict[str, Any]:
+    from simple_pbft_tpu.client import Client
+    from simple_pbft_tpu.node import make_transport
+
+    cell_dir = os.path.join(work_dir, name)
+    shutil.rmtree(cell_dir, ignore_errors=True)
+    os.makedirs(cell_dir)
+    log_dir = os.path.join(cell_dir, "log")
+    options: Dict[str, Any] = dict(
+        checkpoint_interval=checkpoint_interval,
+        view_timeout=view_timeout,
+    )
+    if reconfig:
+        options["admin_ids"] = ["c0"]
+    dep = deploy.generate(
+        cell_dir, n=n, clients=clients, base_port=base_port, **options
+    )
+
+    procs: List[subprocess.Popen] = []
+    client_objs: List = []
+    client_transports: List = []
+    pumps: List[asyncio.Task] = []
+    rec: Dict[str, Any] = {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "bench": "wan_campaign",
+        "cell": name,
+        "t_wall": round(time.time(), 1),
+        "n": n,
+        "profile": profile,
+        "transport": transport,
+        "verifier": verifier,
+        "clients": clients,
+        "outstanding": outstanding,
+        "seconds": seconds,
+    }
+    try:
+        for i in range(n):
+            argv = [
+                python, "-m", "simple_pbft_tpu.node",
+                "--id", f"r{i}",
+                "--deploy-dir", cell_dir,
+                "--verifier", verifier,
+                "--transport", transport,
+                "--status-port", "0",
+                "--log-dir", log_dir,
+                "--flight-interval", "2.0",
+                "--trace-sample", "0",
+                "--stall-deadline", "0",
+                "--audit", "0",
+            ]
+            if profile != "none":
+                argv += ["--wan-profile", profile]
+            with open(os.path.join(cell_dir, f"r{i}.out"), "w") as out_fh:
+                procs.append(subprocess.Popen(
+                    argv, stdout=out_fh, stderr=subprocess.STDOUT,
+                    env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                ))
+
+        # wait for every node's status file, then its first scrape
+        endpoints: Dict[str, str] = {}
+        deadline = time.perf_counter() + NODE_BOOT_TIMEOUT_S
+        while len(endpoints) < n:
+            if time.perf_counter() > deadline:
+                raise RuntimeError(
+                    f"{name}: only {len(endpoints)}/{n} nodes serving "
+                    f"after {NODE_BOOT_TIMEOUT_S:.0f}s"
+                )
+            for path in glob.glob(os.path.join(log_dir, "*.status.json")):
+                rid = os.path.basename(path)[: -len(".status.json")]
+                if rid in endpoints:
+                    continue
+                try:
+                    doc = json.load(open(path))
+                    hp = f"{doc.get('host', '127.0.0.1')}:{doc['port']}"
+                except (OSError, ValueError, KeyError):
+                    continue
+                if await asyncio.to_thread(_scrape, hp, 2.0) is not None:
+                    endpoints[rid] = hp
+            await asyncio.sleep(0.5)
+
+        for ci in range(clients):
+            t = make_transport(transport, f"c{ci}", dep)
+            await t.start()
+            client_transports.append(t)
+            c = Client(
+                client_id=f"c{ci}", cfg=dep.cfg,
+                seed=deploy.read_seed(cell_dir, f"c{ci}"),
+                transport=t, request_timeout=15.0,
+            )
+            if profile == "lossy":
+                c.hedge = 1  # a lost first send must not cost a timeout
+            c.start()
+            client_objs.append(c)
+
+        # warm up: the pipeline must be committing before the window
+        warm_deadline = time.perf_counter() + WARMUP_TIMEOUT_S
+        while True:
+            try:
+                if await client_objs[0].submit("put warm 1", retries=6) == "ok":
+                    break
+            except Exception:
+                pass
+            if time.perf_counter() > warm_deadline:
+                raise RuntimeError(f"{name}: no commit within warmup budget")
+
+        start_snaps = await _scrape_all(endpoints)
+        latencies: List[Tuple[float, float]] = []
+        errors: List[int] = []
+        t_start = time.perf_counter()
+        stop_at = t_start + seconds
+        per_client = max(1, outstanding // max(1, clients))
+        pumps = [
+            asyncio.create_task(_pump(c, stop_at, latencies, errors))
+            for c in client_objs
+            for _ in range(per_client)
+        ]
+
+        reconfig_result: Optional[str] = None
+        if reconfig:
+            # fire the membership change mid-window, under full load; a
+            # failed submit must not orphan the pumps (the finally
+            # cancels them, but give the ledger the denial string)
+            await asyncio.sleep(seconds * 0.4)
+            spec = json.dumps({"remove": [f"r{n - 1}"]})
+            try:
+                reconfig_result = await client_objs[0].submit(
+                    f"__reconfig__ {spec}",
+                    retries=max(3, client_objs[0].retries_for_patience(45.0)),
+                )
+            except Exception as e:
+                reconfig_result = f"submit-failed:{e!r}"
+
+        await asyncio.gather(*pumps, return_exceptions=True)
+        elapsed = time.perf_counter() - t_start
+        # the measurement-window edge: wire/latency numbers come from
+        # THIS scrape — the reconfig activation wait below scrapes
+        # separately so boundary/tick traffic never pollutes the
+        # per-commit costs
+        end_snaps = await _scrape_all(endpoints)
+
+        act_snaps = end_snaps
+        if reconfig:
+            # the staged change activates at the next checkpoint
+            # boundary; keep a trickle of load until every surviving
+            # replica reports the new epoch
+            act_deadline = time.perf_counter() + 60.0
+            while time.perf_counter() < act_deadline:
+                act_snaps = await _scrape_all(endpoints)
+                epochs = [
+                    (s.get("replica") or {}).get("epoch", 0)
+                    for rid, s in act_snaps.items()
+                    if rid != f"r{n - 1}"
+                ]
+                if epochs and min(epochs) >= 1:
+                    break
+                try:
+                    await client_objs[0].submit("put tick 1", retries=4)
+                except Exception:
+                    pass
+                await asyncio.sleep(0.5)
+
+        committed = sum(1 for done_at, _ in latencies if done_at <= stop_at)
+        window = min(elapsed, seconds)
+        lat_ms = sorted(x * 1e3 for _, x in latencies)
+
+        def pct(p: float) -> float:
+            return (
+                lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))]
+                if lat_ms else 0.0
+            )
+
+        def exec_max(snaps: Dict[str, dict]) -> int:
+            return max(
+                ((s.get("replica") or {}).get("executed_seq", 0)
+                 for s in snaps.values()),
+                default=0,
+            )
+
+        slots_delta = exec_max(end_snaps) - exec_max(start_snaps)
+        kinds = wire_delta(
+            wire_aggregate(_wire_rows(start_snaps)),
+            wire_aggregate(_wire_rows(end_snaps)),
+        )
+        shaped_lost = partition_dropped = 0
+        for s in end_snaps.values():
+            sh = (s.get("transport") or {}).get("shaping") or {}
+            shaped_lost += sh.get("shaped_lost", 0)
+            partition_dropped += sh.get("partition_dropped", 0)
+        rec.update({
+            "window_s": round(window, 1),
+            "committed_req_s": round(committed / max(window, 1e-9), 1),
+            "completed_total": len(latencies),
+            "p50_ms": round(pct(0.50), 2),
+            "p99_ms": round(pct(0.99), 2),
+            "client_timeouts": len(errors),
+            "slots": slots_delta,
+            "views_end": sorted({
+                (s.get("replica") or {}).get("view", 0)
+                for s in end_snaps.values()
+            }),
+            "replicas_scraped": len(end_snaps),
+            "shaped_lost": shaped_lost,
+            "partition_dropped": partition_dropped,
+            "wire": {
+                "per_kind": kinds,
+                "per_commit": wire_per_commit(
+                    kinds, slots_delta, max(1, committed)
+                ),
+            },
+        })
+        if reconfig:
+            epochs_end = {
+                rid: (s.get("replica") or {}).get("epoch", 0)
+                for rid, s in act_snaps.items()
+            }
+            survivors = [
+                e for rid, e in epochs_end.items() if rid != f"r{n - 1}"
+            ]
+            rec["reconfig"] = {
+                "result": reconfig_result,
+                "removed": f"r{n - 1}",
+                "epochs_end": epochs_end,
+                # EVERY surviving replica reached the new epoch (and all
+                # n-1 survivors were scraped) — the docs' contract
+                "activated": (
+                    len(survivors) == n - 1
+                    and all(e >= 1 for e in survivors)
+                ),
+            }
+    finally:
+        for t in pumps:
+            t.cancel()
+        if pumps:
+            # a cell failing before its gather (boot error, budget
+            # timeout) must not leave orphaned pumps submitting into the
+            # next cell's port range
+            await asyncio.gather(*pumps, return_exceptions=True)
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for c in client_objs:
+            try:
+                await c.stop()
+            except Exception:
+                pass
+        for t in client_transports:
+            try:
+                await t.stop()
+            except Exception:
+                pass
+
+    # post-mortem artifacts (node processes are down; their span/flight
+    # files are complete): dominant-path decomposition per cell, and the
+    # reconfiguration cell's spike measurement
+    spans = critical_path.load_spans(critical_path.discover(log_dir))
+    if spans:
+        an = critical_path.analyze(spans, [50.0, 99.0])
+        rec["critical_path"] = {
+            "slots_complete": an["slots_complete"],
+            "decomposition": an["decomposition"],
+        }
+    if reconfig:
+        rec.setdefault("reconfig", {})
+        rec["reconfig"]["spike"] = reconfig_spike_from_spans(log_dir)
+        rec["reconfig"]["spike_width_s"] = rec["reconfig"]["spike"]["width_s"]
+    if not keep_dir:
+        shutil.rmtree(cell_dir, ignore_errors=True)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# sweep
+# ---------------------------------------------------------------------------
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser(description="WAN measurement campaign")
+    ap.add_argument("--out", default="bench_results/wan_campaign.jsonl")
+    ap.add_argument("--ns", default="4,16,32,64",
+                    help="comma list of committee sizes")
+    ap.add_argument("--profiles", default="none,wan3dc,lossy",
+                    help="comma list of WAN profiles (none = unshaped)")
+    ap.add_argument("--transport", default="tcp", choices=["tcp", "grpc"])
+    ap.add_argument("--verifier", default="cpu",
+                    choices=["cpu", "cpu-pure", "insecure"])
+    ap.add_argument("--seconds", type=float, default=8.0)
+    ap.add_argument("--clients", type=int, default=2)
+    ap.add_argument("--outstanding", default="16",
+                    help="comma list of in-flight request loads (the "
+                    "load axis of the sweep)")
+    ap.add_argument("--work-dir", default="/tmp/wan_campaign")
+    ap.add_argument("--base-port", type=int, default=7400)
+    ap.add_argument("--reconfig-cell", dest="reconfig_cell",
+                    action="store_true", default=True)
+    ap.add_argument("--no-reconfig-cell", dest="reconfig_cell",
+                    action="store_false",
+                    help="skip the reconfiguration-under-load cell")
+    ap.add_argument("--reconfig-n", type=int, default=5,
+                    help="committee size for the reconfiguration cell "
+                    "(one member is removed under load; n-1 >= 4)")
+    ap.add_argument("--checkpoint-interval", type=int, default=32)
+    ap.add_argument("--view-timeout", type=float, default=30.0)
+    ap.add_argument("--cell-budget", type=float, default=600.0,
+                    help="hard wall-clock bound per cell")
+    ap.add_argument("--keep-dirs", action="store_true")
+    args = ap.parse_args()
+
+    ns = [int(x) for x in args.ns.split(",") if x.strip()]
+    profiles = [p.strip() for p in args.profiles.split(",") if p.strip()]
+    loads = [int(x) for x in args.outstanding.split(",") if x.strip()]
+    os.makedirs(args.work_dir, exist_ok=True)
+    out_dir = os.path.dirname(args.out)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+
+    cells: List[Dict[str, Any]] = []
+    for n in ns:
+        for profile in profiles:
+            for load in loads:
+                cells.append(dict(
+                    name=f"wan-{args.transport}-n{n}-{profile}-o{load}",
+                    n=n, profile=profile, outstanding=load, reconfig=False,
+                ))
+    if args.reconfig_cell:
+        cells.append(dict(
+            name=f"wan-{args.transport}-n{args.reconfig_n}-none-"
+                 f"o{loads[0]}-reconfig",
+            n=args.reconfig_n, profile="none", outstanding=loads[0],
+            reconfig=True,
+        ))
+
+    failures = 0
+    base_port = args.base_port
+    for idx, cell in enumerate(cells):
+        print(f"[{idx + 1}/{len(cells)}] {cell['name']} ...",
+              file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        try:
+            rec = await asyncio.wait_for(
+                run_cell(
+                    name=cell["name"], n=cell["n"], profile=cell["profile"],
+                    transport=args.transport, seconds=args.seconds,
+                    clients=args.clients, outstanding=cell["outstanding"],
+                    work_dir=args.work_dir, base_port=base_port,
+                    verifier=args.verifier, python=sys.executable,
+                    reconfig=cell["reconfig"],
+                    checkpoint_interval=args.checkpoint_interval,
+                    view_timeout=args.view_timeout,
+                    keep_dir=args.keep_dirs,
+                ),
+                timeout=args.cell_budget,
+            )
+        except (Exception, asyncio.TimeoutError) as e:
+            failures += 1
+            print(f"  FAILED {cell['name']}: {e!r}", file=sys.stderr)
+            base_port += 1000
+            continue
+        with open(args.out, "a") as fh:
+            fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            fh.flush()
+        print(
+            f"  {rec['committed_req_s']} req/s, p50 {rec['p50_ms']} ms, "
+            f"p99 {rec['p99_ms']} ms, "
+            f"{rec['wire']['per_commit']['total_msgs_per_slot']} msgs/slot "
+            f"({time.perf_counter() - t0:.0f}s)",
+            file=sys.stderr, flush=True,
+        )
+        base_port += 1000
+
+    print(f"campaign: {len(cells) - failures}/{len(cells)} cells -> "
+          f"{args.out}", file=sys.stderr)
+    if failures:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
