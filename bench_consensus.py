@@ -190,7 +190,12 @@ async def run_config(
     # off --fault-schedule so a run's faults are a pure function of its
     # seed — reproducible, host-independent, diffable between A/B arms
     schedule = None
-    if fault_spec:
+    if isinstance(fault_spec, FaultSchedule):
+        # --replay: the EXACT recorded schedule (rebuilt from a ledger
+        # line's faults block via FaultSchedule.from_summary), never a
+        # re-parse — replay must not depend on generate()'s dealing
+        schedule = fault_spec
+    elif fault_spec:
         schedule = FaultSchedule.parse(
             fault_spec, horizon=seconds,
             replica_ids=[f"r{i}" for i in range(n)],
@@ -459,7 +464,14 @@ async def run_config(
             service=service if verifier == "tpu" else None,
             slow=slow_wrap,
         )
-        injector_task = asyncio.create_task(injector.run(stop_at))
+        # the injector's deadline rides the CLOCK SEAM's timebase
+        # (clock.now() — virtual under simulation), which shares no
+        # epoch with the perf_counter-based bench window above
+        from simple_pbft_tpu import clock as pbft_clock
+
+        injector_task = asyncio.create_task(
+            injector.run(pbft_clock.now() + seconds)
+        )
 
     crash_info = {}
     if storm:
@@ -769,6 +781,15 @@ async def main() -> None:
         "audit plane's ledgers prove detection (docs/AUDIT.md)",
     )
     ap.add_argument(
+        "--replay", default=None, metavar="RECORD",
+        help="replay the EXACT fault schedule of a previous run from "
+        "its bench record (a JSON file, or a .jsonl ledger — last line "
+        "wins): the record's faults block carries the complete (seed, "
+        "horizon, event list, kind-table crc) tuple, so the schedule "
+        "reconstructs without the original CLI spec; --seconds is "
+        "overridden by the recorded horizon",
+    )
+    ap.add_argument(
         "--verify-deadline", type=float, default=60.0,
         help="tpu verify service: device dispatch deadline in seconds "
         "before the watchdog fails the sweep over to the CPU verifier "
@@ -856,6 +877,33 @@ async def main() -> None:
             sys.exit(f"bad --chaos spec {args.chaos!r}: "
                      f"use drop=0.02,delay=0.03,dup=0.01,seed=42")
 
+    replay_schedule = None
+    if args.replay:
+        from simple_pbft_tpu.faults import FaultSchedule
+
+        with open(args.replay) as f:
+            text = f.read()
+        try:
+            # a single JSON document (bench record, sim repro artifact —
+            # artifacts are pretty-printed, so they span many lines)
+            doc = json.loads(text)
+        except json.JSONDecodeError:
+            # a .jsonl ledger: the last record wins
+            lines = [ln for ln in text.splitlines() if ln.strip()]
+            doc = json.loads(lines[-1])
+        faults = doc.get("faults") or (
+            (doc.get("scenario") or {}).get("schedule")
+        )
+        if not faults:
+            sys.exit(f"{args.replay!r} carries no faults block "
+                     "(nothing to replay)")
+        replay_schedule = FaultSchedule.from_summary(faults)
+        args.seconds = replay_schedule.horizon
+        print(f"[replay] {args.replay}: seed={replay_schedule.seed} "
+              f"horizon={replay_schedule.horizon}s "
+              f"events={len(replay_schedule.events)}"
+              + (f" (recorded n={doc['n']})" if doc.get("n") else ""))
+
     for key in args.configs.split(","):
         key = key.strip()
         if key not in ladder:
@@ -866,7 +914,7 @@ async def main() -> None:
             )
         cfg = ladder[key]
         resilience = dict(
-            fault_spec=args.fault_schedule,
+            fault_spec=replay_schedule or args.fault_schedule,
             verify_deadline=args.verify_deadline,
             verify_max_pending=args.verify_max_pending,
             status_port_base=args.status_port_base,

@@ -16,11 +16,10 @@ from __future__ import annotations
 import asyncio
 import itertools
 import random
-import time
 from collections import defaultdict
 from typing import Dict, Optional
 
-from . import spans
+from . import clock, spans
 from .config import CommitteeConfig, config_from_doc
 from .crypto.signer import Signer
 from .crypto.verifier import BatchItem, Verifier, best_cpu_verifier
@@ -88,7 +87,8 @@ class Client:
         from .crypto import mac as mac_mod
 
         self._mac = mac_mod.MacBank(seed, cfg.kx_pubkeys)
-        # microsecond wall-clock start (Castro-Liskov §2.4: client
+        # microsecond wall-clock start via the clock seam (virtual and
+        # deterministic under simulation) (Castro-Liskov §2.4: client
         # timestamps are monotonic ACROSS restarts — a counter from 1
         # would leave a restarted client below the replicas' per-client
         # dedup watermark, every request silently dropped as a replay;
@@ -97,7 +97,7 @@ class Client:
         # stepped BACKWARDS across a restart re-enters the replay window
         # until wall-clock passes the old watermark; deploy clients with
         # slewing (not stepping) time sync, or persist the last timestamp.
-        self._ts = itertools.count(int(time.time() * 1_000_000))
+        self._ts = itertools.count(clock.timestamp_us())
         self._waiters: Dict[int, asyncio.Future] = {}
         # per-ts replies: sender -> (result, superseded) — matched as a pair
         self._replies: Dict[int, Dict[str, tuple]] = defaultdict(dict)
@@ -265,7 +265,7 @@ class Client:
         f+1 of our current book are members of the new committee).
         Rate-limited: every reply from the new epoch would otherwise
         re-fire the round."""
-        now = time.monotonic()
+        now = clock.now()
         if now - self._config_fetch_at < 0.5:
             return
         self._config_fetch_at = now
@@ -400,7 +400,7 @@ class Client:
         traced = rid is not None
         if traced:
             tracer.emit("submit", rid, op_bytes=len(operation))
-        t_sub = time.perf_counter()
+        t_sub = clock.now()
         try:
             # first attempt: primary (+ hedged backups); afterwards:
             # broadcast (classic PBFT retransmission — backups forward to
@@ -431,7 +431,7 @@ class Client:
                     # only for SAMPLED requests (volume bound).
                     spans.record(
                         spans.CLIENT_E2E,
-                        time.perf_counter() - t_sub,
+                        clock.now() - t_sub,
                         node=self.id, rid=rid, persist=traced,
                     )
                     return result

@@ -169,6 +169,7 @@ def verify_qc(cfg, qc: QuorumCert) -> bool:
         waiter.wait()  # another thread is computing this exact pairing
     ok: Optional[bool] = None
     try:
+        # pbftlint: disable=PBL001 -- loop residency only via verify_qc_async's clock.simulated() branch (sim-only by contract); every production caller runs in the lane worker or an executor thread
         ok = bls.verify_aggregate(pks, payload, agg)
     finally:
         with _cache_lock:
@@ -524,7 +525,19 @@ def lane_snapshot() -> Optional[dict]:
 async def verify_qc_async(cfg, qc: QuorumCert) -> bool:
     """The runtime's certificate check: submit to the lane and await the
     batched verdict off-loop. Raises QcLaneOverloaded when the lane's
-    admission queue is at cap (callers shed; the cert re-arrives)."""
+    admission queue is at cap (callers shed; the cert re-arrives).
+
+    Under simulation (simple_pbft_tpu/sim.py) the pairing runs INLINE:
+    the lane's worker thread completes in wall time, which a virtual
+    clock outruns arbitrarily — every downstream interleaving would
+    race it. Loop-blocking is harmless there (nothing real-time shares
+    a simulated loop), and the verdict memo keeps the cost one pairing
+    per distinct certificate either way."""
     import asyncio
 
+    from .. import clock
+
+    if clock.simulated():
+        # pbftlint: disable=PBL001 -- sim-only branch: clock.simulated() gates it off every production loop; blocking a simulated loop is the determinism contract, not a stall
+        return verify_qc(cfg, qc)
     return await asyncio.wrap_future(qc_lane().submit(cfg, qc))

@@ -32,11 +32,10 @@ import asyncio
 import hashlib
 import logging
 import threading
-import time
 from collections import OrderedDict, defaultdict
 from typing import Any, Dict, List, Optional, Tuple
 
-from .. import spans
+from .. import clock, spans
 from ..app import Application, KVStore
 from ..config import (
     CommitteeConfig,
@@ -551,7 +550,7 @@ class Replica:
                     )
                 else:
                     verify_task = asyncio.get_running_loop().create_task(
-                        asyncio.to_thread(self._timed_verify, items)
+                        clock.off_thread(self._timed_verify, items)
                     )
             self.metrics["verified_sigs"] += len(items)
         return decoded, sig_spans, verify_task
@@ -646,26 +645,26 @@ class Replica:
         Already-verified signatures answer from the per-replica cache
         (locked: the pipeline overlaps consecutive sweeps' verifies in
         separate executor threads)."""
-        t0 = time.perf_counter()
+        t0 = clock.now()
         out, fresh, fresh_keys = self._cache_filter(items)
         if fresh:
             verdicts = self.verifier.verify_batch(fresh)
             self._cache_store(fresh_keys, verdicts, out)
         self.metrics["sig_cache_hits"] += len(items) - len(fresh)
-        self._record_verify(len(fresh), time.perf_counter() - t0)
+        self._record_verify(len(fresh), clock.now() - t0)
         return out
 
     async def _submit_verify(self, items: List[BatchItem]) -> List[bool]:
         """Coalescing-service path: submit the fresh work and await the
         future — the event loop stays free, and concurrent replicas'
         sweeps ride the same device pass (crypto/coalesce.py)."""
-        t0 = time.perf_counter()
+        t0 = clock.now()
         if len(items) > 256:
             # the filter hashes every item (sha256 cache keys) — a full
             # 4096-item sweep is multiple ms, too long to hold the loop
             # that every replica in the process shares; small sweeps stay
             # inline (a thread handoff costs more than the hashing)
-            out, fresh, fresh_keys = await asyncio.to_thread(
+            out, fresh, fresh_keys = await clock.off_thread(
                 self._cache_filter, items
             )
         else:
@@ -674,13 +673,13 @@ class Replica:
             verdicts = await asyncio.wrap_future(self.verifier.submit(fresh))
             self._cache_store(fresh_keys, verdicts, out)
         self.metrics["sig_cache_hits"] += len(items) - len(fresh)
-        self._record_verify(len(fresh), time.perf_counter() - t0)
+        self._record_verify(len(fresh), clock.now() - t0)
         return out
 
     async def _finish_sweep(self, decoded, sig_spans, verify_task) -> None:
         if not decoded:
             return
-        t0 = time.perf_counter()
+        t0 = clock.now()
         accepted = decoded
         if self.cfg.verify_signatures:
             try:
@@ -715,7 +714,7 @@ class Replica:
                 self.auditor.observe_message(msg)
             await self._route(msg)
         await self._propose_if_ready()
-        self.stats.sweep_ms.record((time.perf_counter() - t0) * 1e3)
+        self.stats.sweep_ms.record((clock.now() - t0) * 1e3)
 
     async def process_sweep(self, sweep: List[bytes]) -> None:
         """Decode a sweep of wire messages, batch-verify every signature in
@@ -959,7 +958,7 @@ class Replica:
                 # exactly while the new view was forming. First answer is
                 # always immediate; repeats within the window are dropped
                 # (the client's next 4.5 s retry beats a 1 s cooldown).
-                now = time.monotonic()
+                now = clock.now()
                 if now - self._reply_resent.get(key, 0.0) < 1.0:
                     self.metrics["reply_resend_squelched"] += 1
                     return
@@ -1158,7 +1157,7 @@ class Replica:
                 return
             actions = inst.on_pre_prepare(msg)
             if inst.pre_prepare is not None and inst.t_started == 0.0:
-                inst.t_started = time.perf_counter()  # commit-latency clock
+                inst.t_started = clock.now()  # commit-latency clock
                 # An admitted proposal IS pending client work (the paper
                 # arms backup view timers exactly here): without this, a
                 # backup that never saw the request itself has no armed
@@ -1217,7 +1216,7 @@ class Replica:
             self.metrics["qc_shed_overload"] += 1
             return None, set()
         self.metrics["qc_aggregate_failed"] += 1
-        good = await asyncio.to_thread(
+        good = await clock.off_thread(
             qc_mod.bisect_bad_shares, self.cfg, phase, view, seq, digest, shares
         )
         bad = set(shares) - set(good)
@@ -1342,7 +1341,7 @@ class Replica:
             inst = self.instances.get((act.view, act.seq))
             if inst is not None and inst.t_started and not inst.t_prepared:
                 # phase span 1/3: pre-prepare admission -> prepared
-                inst.t_prepared = time.perf_counter()
+                inst.t_prepared = clock.now()
                 spans.record(
                     spans.PHASE_PREPARE,
                     inst.t_prepared - inst.t_started,
@@ -1365,7 +1364,7 @@ class Replica:
                 # that skipped local preparation (QC catch-up, adopted
                 # blocks) anchor on t_started; slots with neither clock
                 # (pure hole repair) have no attributable wait to record.
-                inst.t_committed = time.perf_counter()
+                inst.t_committed = clock.now()
                 base = inst.t_prepared or inst.t_started
                 if base:
                     spans.record(
@@ -1426,7 +1425,7 @@ class Replica:
         while (self.executed_seq + 1) in self.ready:
             act = self.ready.pop(self.executed_seq + 1)
             self.executed_seq += 1
-            self.last_commit_mono = time.monotonic()
+            self.last_commit_mono = clock.now()
             self.committed_log[act.seq] = act.digest
             self.metrics["committed_blocks"] += 1
             if self.auditor is not None:
@@ -1434,7 +1433,7 @@ class Replica:
                 # cross-node agreement matrix joins (audit I3)
                 self.auditor.observe_commit(act.view, act.seq, act.digest)
             src = self.instances.get((act.view, act.seq))
-            now_pc = time.perf_counter()
+            now_pc = clock.now()
             if src is not None and src.t_started:
                 self.stats.commit_ms.record((now_pc - src.t_started) * 1e3)
             if src is not None and src.t_committed:
@@ -1753,7 +1752,7 @@ class Replica:
         sender; the reply is signed, and a client adopts only on f+1
         matching copies from replicas it already knows — one lying
         replica cannot steer a client into a fake committee."""
-        now = time.monotonic()
+        now = clock.now()
         key = f"cfg:{msg.sender}"
         if now - self._slot_fetch_served.get(key, 0.0) < self.SLOT_FETCH_COOLDOWN:
             self.metrics["slot_fetch_throttled"] += 1
@@ -2230,7 +2229,7 @@ class Replica:
         shares and no QC until someone re-asks)."""
         v = self.view
         base = self.executed_seq
-        now = time.perf_counter()
+        now = clock.now()
         # Small age floor only — the STALL decision lives at the caller
         # (ViewChanger._probe fires this solely when execution made no
         # progress between probe ticks). A hard 3 s per-instance age gate
@@ -2337,7 +2336,7 @@ class Replica:
         # no view gate: instance-artifact lookups key on the REQUESTER's
         # view (a mismatch just misses), and executed blocks are
         # view-independent and self-authenticating either way
-        now = time.monotonic()
+        now = clock.now()
         last = self._slot_fetch_served.get(msg.sender, 0.0)
         if now - last < self.SLOT_FETCH_COOLDOWN:
             self.metrics["slot_fetch_throttled"] += 1
@@ -2389,7 +2388,7 @@ class Replica:
         nv = self.last_new_view
         if nv is None or msg.view <= 0 or nv.new_view < msg.view:
             return
-        now = time.monotonic()
+        now = clock.now()
         key = f"nv:{msg.sender}"
         if now - self._slot_fetch_served.get(key, 0.0) < self.SLOT_FETCH_COOLDOWN:
             self.metrics["slot_fetch_throttled"] += 1

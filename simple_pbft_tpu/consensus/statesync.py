@@ -47,12 +47,23 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 from typing import Dict, List, Optional, Set
 
+from .. import clock
 from ..messages import StateChunkReply, StateChunkRequest
 
 log = logging.getLogger("pbft.statesync")
+
+#: Planted-defect knobs for simulation validation (ISSUE 13): the
+#: schedule-search loop must be proven able to FIND a real bug class,
+#: so known-fixed defects can be re-armed here (by the sim harness
+#: only — sim.Scenario.defects) and hunted by coverage-guided search.
+#: Production/test code never sets this. Known knobs:
+#:   "sync_abandon_leak" — re-opens the PR 7 wedge: an abandoned
+#:   transfer keeps ``pending_sync`` held, so _stabilize's dedup guard
+#:   swallows retransmitted checkpoint quorums at the same seq and a
+#:   committee that needs this replica for quorum wedges forever.
+DEFECTS: Set[str] = set()
 
 CHUNK_BYTES = 256 * 1024
 MAX_CHUNKS = 4096  # 1 GiB snapshot ceiling — beyond this the deployment
@@ -186,7 +197,7 @@ class StateSync:
         if a is None:
             return
         ring = self._peer_ring(a)
-        now = time.monotonic()
+        now = clock.now()
         sent = 0
         for idx in self._missing(a):
             if sent >= WINDOW:
@@ -210,11 +221,18 @@ class StateSync:
         not the transfer."""
         try:
             while self.active is not None:
-                await asyncio.sleep(RETRY_S)
+                await clock.sleep(RETRY_S)
                 a = self.active
                 if a is None:
                     return
                 a["rounds"] += 1
+                # observability (and the sim search's fitness ramp
+                # toward starvation interleavings): the worst
+                # consecutive no-progress stretch any transfer saw
+                if a["rounds"] > self.r.metrics.get(
+                    "statesync_stall_ticks_max", 0
+                ):
+                    self.r.metrics["statesync_stall_ticks_max"] = a["rounds"]
                 if a["rounds"] > MAX_ROUNDS:
                     # abandon: the next checkpoint quorum (or NEW-VIEW)
                     # re-triggers _stabilize -> begin with fresh peers.
@@ -224,9 +242,10 @@ class StateSync:
                     # a committee that cannot advance without us never
                     # produces a later one: wedged forever
                     self.r.metrics["statesync_abandoned"] += 1
-                    ps = self.r.pending_sync
-                    if ps is not None and ps[0] <= a["seq"]:
-                        self.r.pending_sync = None
+                    if "sync_abandon_leak" not in DEFECTS:
+                        ps = self.r.pending_sync
+                        if ps is not None and ps[0] <= a["seq"]:
+                            self.r.pending_sync = None
                     self.active = None
                     return
                 if (
@@ -333,7 +352,7 @@ class StateSync:
     # ------------------------------------------------------------------
 
     async def on_chunk_request(self, msg: StateChunkRequest) -> None:
-        now = time.monotonic()
+        now = clock.now()
         tokens, last = self._serve_bucket.get(
             msg.sender, (float(SERVE_BURST), now)
         )

@@ -37,6 +37,7 @@ import random
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
+from .. import clock
 from ..crypto.verifier import BatchItem
 from ..messages import (
     EMPTY_BLOCK_DIGEST,
@@ -797,7 +798,7 @@ class ViewChanger:
         if not qcs:
             return True
         cfg = self.r.cfg
-        return await asyncio.to_thread(qc_mod.verify_qcs_all, cfg, list(qcs))
+        return await clock.off_thread(qc_mod.verify_qcs_all, cfg, list(qcs))
 
     # -- receiving ------------------------------------------------------
 

@@ -26,6 +26,7 @@ WAN link shaping additionally through node.py's --wan-profile flag
 from __future__ import annotations
 
 import asyncio
+import logging
 import random
 import threading
 import time
@@ -33,6 +34,7 @@ import zlib
 from dataclasses import dataclass, field
 from typing import ClassVar, Dict, List, Optional, Sequence, Set, Tuple
 
+from . import clock
 from .crypto.signer import Signer
 from .messages import Checkpoint, Message, PrePrepare, sha256_hex
 from .transport import base as base_transport
@@ -94,6 +96,8 @@ KIND_REGISTRY: Dict[str, str] = {
 }
 
 KINDS = tuple(KIND_REGISTRY)
+
+log = logging.getLogger("pbft.faults")
 
 
 def kind_table() -> str:
@@ -397,17 +401,76 @@ class FaultSchedule:
         return FaultEvent(t=t, kind="partition", spec=parts[1],
                           duration=dur)
 
+    #: summary()/from_summary() wire format version (ISSUE 13 satellite:
+    #: any failing run's exact schedule must reconstruct from its ledger
+    #: line alone)
+    SUMMARY_SCHEMA: ClassVar[str] = "fault-schedule-v2"
+
     def summary(self) -> dict:
-        """Bench-record form: enough to regenerate AND to eyeball."""
+        """Ledger/bench-record form: the complete replay tuple. Carries
+        (seed, horizon, the full event list, and a kind-table
+        fingerprint), so :meth:`from_summary` rebuilds the EXACT
+        schedule from a ledger line with no access to the original CLI
+        spec or generate() arguments — and a replay attempted against a
+        drifted kind registry fails loudly instead of silently meaning
+        different faults."""
         kinds: Dict[str, int] = {}
         for e in self.events:
             kinds[e.kind] = kinds.get(e.kind, 0) + 1
         return {
+            "schema": self.SUMMARY_SCHEMA,
             "seed": self.seed,
             "horizon_s": round(self.horizon, 1),
+            # crc over the ordered kind table: replaying a ledger line
+            # under a registry that renamed/removed kinds must not
+            # silently reinterpret the schedule
+            "kinds_crc": zlib.crc32(",".join(KINDS).encode()) & 0xFFFFFFFF,
             "counts": kinds,
             "events": [e.to_dict() for e in self.events],
         }
+
+    @classmethod
+    def from_summary(cls, doc: dict) -> "FaultSchedule":
+        """Rebuild the exact schedule from a :meth:`summary` dict (a
+        bench record's ``faults`` block, a campaign ledger line, a sim
+        repro artifact). Unknown event kinds are an error — the ledger
+        predates/postdates this registry and a replay would lie."""
+        crc = doc.get("kinds_crc")
+        here = zlib.crc32(",".join(KINDS).encode()) & 0xFFFFFFFF
+        if crc is not None and int(crc) != here:
+            # the registry changed since this schedule was recorded.
+            # Per-event name lookup below still hard-fails on renames/
+            # removals; a mismatch with all names resolving means the
+            # registry GREW (or semantics drifted) — replay proceeds,
+            # loudly, so a semantics drift is never silent
+            log.warning(
+                "replaying a schedule recorded under a different fault-"
+                "kind registry (crc %s, current %s): additions are fine, "
+                "semantic drift is not — review KIND_REGISTRY history",
+                crc, here,
+            )
+        events = []
+        for e in doc.get("events", ()):
+            kind = e.get("kind", "")
+            if kind not in KIND_REGISTRY:
+                raise ValueError(
+                    f"cannot replay: unknown fault kind {kind!r} "
+                    f"(known: {sorted(KIND_REGISTRY)}); the schedule "
+                    "was recorded under a different kind registry"
+                )
+            events.append(FaultEvent(
+                t=float(e["t"]),
+                kind=kind,
+                target=str(e.get("target", "")),
+                duration=float(e.get("duration", 0.0)),
+                magnitude=float(e.get("magnitude", 0.0)),
+                spec=str(e.get("spec", "")),
+            ))
+        return cls(
+            seed=int(doc.get("seed", 0)),
+            horizon=float(doc.get("horizon_s", 0.0)),
+            events=tuple(events),
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -625,6 +688,7 @@ class ShapedTransport:
         if sh.jitter_s:
             delay += sh.jitter_s * self.rng.random()
         loop = asyncio.get_running_loop()
+        # pbftlint: disable=PBL007 -- feeds call_at on the SAME loop: this IS the virtualized timebase, not a seam bypass
         now = loop.time()  # the clock call_at schedules against
         if sh.bw_bytes_per_s > 0:
             # serialize through the link: frames queue behind the byte
@@ -1028,17 +1092,19 @@ class FaultInjector:
         return sum(w.injections for w in self.byzantine)
 
     async def run(self, stop_at: float) -> None:
-        """Fire events at their offsets until done or ``stop_at``
-        (perf_counter deadline). Call alongside the load pumps."""
-        t0 = time.perf_counter()
+        """Fire events at their offsets until done or ``stop_at`` (a
+        ``clock.now()`` deadline — virtual under simulation, so a
+        schedule replays at identical VIRTUAL offsets regardless of how
+        fast the host runs). Call alongside the load pumps."""
+        t0 = clock.now()
         for ev in self.schedule.events:
             fire = t0 + ev.t
             while True:
-                now = time.perf_counter()
+                now = clock.now()
                 if now >= fire or now >= stop_at:
                     break
-                await asyncio.sleep(min(0.05, fire - now))
-            if time.perf_counter() >= stop_at:
+                await clock.sleep(min(0.05, fire - now))
+            if clock.now() >= stop_at:
                 break
             self._apply(ev)
         # hold the task open until every window has restored (restores
@@ -1314,7 +1380,7 @@ class FaultInjector:
 
     def _after(self, delay: float, fn) -> None:
         async def later():
-            await asyncio.sleep(delay)
+            await clock.sleep(delay)
 
         task = asyncio.get_running_loop().create_task(later())
         # done-callback, NOT a finally inside the coroutine: a task

@@ -51,7 +51,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
-from . import sanitize
+from . import clock, sanitize
 from .transport import base as transport_base
 
 log = logging.getLogger("pbft.telemetry")
@@ -235,7 +235,7 @@ def replica_snapshot(replica) -> Dict[str, Any]:
         # the stall gauge pbft_top's CAGE column and the progress
         # watchdog both read
         "last_commit_age_s": (
-            round(time.monotonic() - last, 3) if last else None
+            round(clock.now() - last, 3) if last else None
         ),
         "view": replica.view,
         "is_primary": replica.is_primary,
@@ -336,15 +336,15 @@ class NodeTelemetry:
         self.client = client
         self.tracer = tracer
         self.loop_lag = loop_lag
-        self._t0 = time.monotonic()
+        self._t0 = clock.now()
 
     def snapshot(self) -> Dict[str, Any]:
-        now = time.monotonic()
+        now = clock.now()
         snap: Dict[str, Any] = {
             "schema": SCHEMA_VERSION,  # historical spelling, kept stable
             "schema_version": SCHEMA_VERSION,
             "node": self.node_id,
-            "t_wall": round(time.time(), 3),
+            "t_wall": round(time.time(), 3),  # pbftlint: disable=PBL007 -- human-facing wall timestamp, not a timer
             "t_mono": round(now, 3),
             "uptime_s": round(now - self._t0, 3),
         }
@@ -401,7 +401,7 @@ class NodeTelemetry:
         return {
             "ok": running,
             "node": self.node_id,
-            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "uptime_s": round(clock.now() - self._t0, 3),
             "degraded": degraded,
         }
 
@@ -488,7 +488,7 @@ class FlightRecorder:
     async def _run(self) -> None:
         while True:
             self.record_once()
-            await asyncio.sleep(self.interval)
+            await clock.sleep(self.interval)
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._run())
@@ -534,9 +534,9 @@ class LoopLagGauge:
 
     async def _run(self) -> None:
         while True:
-            due = time.monotonic() + self.interval
-            await asyncio.sleep(self.interval)
-            lag_ms = max(0.0, (time.monotonic() - due)) * 1e3
+            due = clock.now() + self.interval
+            await clock.sleep(self.interval)
+            lag_ms = max(0.0, (clock.now() - due)) * 1e3
             self.last_ms = lag_ms
             self.samples += 1
             if lag_ms > self.max_ms:
@@ -687,7 +687,7 @@ class ProgressWatchdog:
         self.dumps = 0
         self.last_dump_path: Optional[str] = None
         self._armed = True
-        self._t_progress = time.monotonic()
+        self._t_progress = clock.now()
         self._last_exec = -1
         self._task: Optional[asyncio.Task] = None
 
@@ -715,7 +715,7 @@ class ProgressWatchdog:
         rep = self.telemetry.replica
         if rep is None:
             return
-        now = time.monotonic()
+        now = clock.now()
         exec_seq = rep.executed_seq
         if exec_seq != self._last_exec:
             self._last_exec = exec_seq
@@ -745,10 +745,10 @@ class ProgressWatchdog:
                 self._check()
             except Exception:  # the watchdog must outlive snapshot bugs
                 log.exception("progress watchdog check failed")
-            await asyncio.sleep(self.interval)
+            await clock.sleep(self.interval)
 
     def start(self) -> None:
-        self._t_progress = time.monotonic()
+        self._t_progress = clock.now()
         self._task = asyncio.get_running_loop().create_task(self._run())
 
     async def stop(self) -> None:
@@ -766,7 +766,7 @@ class ProgressWatchdog:
         rep = self.telemetry.replica
         if rep is None:
             return []
-        now = time.perf_counter()
+        now = clock.now()
         rows = []
         for (view, seq), inst in sorted(rep.instances.items())[:limit]:
             if inst.executed:
@@ -810,8 +810,8 @@ class ProgressWatchdog:
             "schema": SCHEMA_VERSION,
             "node": self.telemetry.node_id,
             "reason": reason,
-            "t_wall": round(time.time(), 3),
-            "t_mono": round(time.monotonic(), 3),
+            "t_wall": round(time.time(), 3),  # pbftlint: disable=PBL007 -- human-facing wall timestamp, not a timer
+            "t_mono": round(clock.now(), 3),
             "suspect": diagnose_stall(snap),
             "snapshot": snap,
             "instances_inflight": self._instance_table(),
@@ -1049,8 +1049,8 @@ class RequestTracer:
             "node": self.node_id,
             "rid": rid,
             "phase": phase,
-            "t_wall": time.time(),
-            "t_mono": time.monotonic(),
+            "t_wall": time.time(),  # pbftlint: disable=PBL007 -- human-facing wall timestamp, not a timer
+            "t_mono": clock.now(),
         }
         for k, v in fields.items():
             if v is not None:

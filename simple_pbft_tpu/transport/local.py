@@ -54,6 +54,11 @@ class LocalNetwork:
         # for that node (accounting must survive re-handles) and readable
         # by _deliver for the receiver-side count at enqueue time
         self.wire_accts: Dict[str, WireAccounting] = {}
+        # optional delivery tap (ISSUE 13: the simulation runtime's
+        # deterministic event trace): called (src, dst, kind, nbytes,
+        # verdict) at every delivery decision. Never allowed to break
+        # delivery — exceptions are swallowed at the call sites.
+        self.trace = None
 
     def wire_for(self, node_id: str) -> WireAccounting:
         w = self.wire_accts.get(node_id)
@@ -66,13 +71,29 @@ class LocalNetwork:
             self.queues[node_id] = asyncio.Queue()
         return LocalEndpoint(node_id, self)
 
+    def _trace(self, src: str, dst: str, kind: str, nbytes: int,
+               verdict: str) -> None:
+        tr = self.trace
+        if tr is None:
+            return
+        try:
+            tr(src, dst, kind, nbytes, verdict)
+        except Exception:
+            # a tracing bug must never break delivery (same contract as
+            # the wire-accounting entry points)
+            self.trace = None
+
     async def _deliver(self, src: str, dst: str, raw: bytes) -> None:
         src_wire = self.wire_accts.get(src)
+        # classify ONCE per logical send: sender and receiver ledgers
+        # must agree on the kind for per-kind conservation to hold
+        kind = src_wire.kind_of(raw) if src_wire is not None else ""
         q = self.queues.get(dst)
         if q is None:
             # unknown destination: silently dropped (fire-and-forget)
             if src_wire is not None:
                 src_wire.account_lost("no_route", raw)
+            self._trace(src, dst, kind, len(raw), "no_route")
             return
         f = self.faults
         if (src, dst) in f.partitions or f.rng.random() < f.drop_rate:
@@ -82,6 +103,7 @@ class LocalNetwork:
             # attempted = sent + lost, and sent == received
             if src_wire is not None:
                 src_wire.account_lost("net_dropped", raw)
+            self._trace(src, dst, kind, len(raw), "dropped")
             return
         copies = 2 if f.rng.random() < f.duplicate_rate else 1
         lo, hi = f.delay_range
@@ -89,10 +111,8 @@ class LocalNetwork:
         # full transport residency (injected fault delay + queue wait +
         # receiver scheduling) — the wire's leg of the critical path
         item = (time.perf_counter(), raw)
-        # classify ONCE per logical send: sender and receiver ledgers
-        # must agree on the kind for per-kind conservation to hold
-        kind = src_wire.kind_of(raw) if src_wire is not None else ""
         dst_wire = self.wire_accts.get(dst)
+        self._trace(src, dst, kind, len(raw), "deliver")
         for _ in range(copies):
             delay = f.rng.uniform(lo, hi) if hi > 0 else 0.0
             if delay > 0:

@@ -1,0 +1,18 @@
+# pbftlint: clock-injectable
+"""PBL007 negative twin: the seam-compliant forms."""
+
+from simple_pbft_tpu import clock
+
+
+def cooldown_stamp():
+    return clock.now()  # virtual under simulation, monotonic otherwise
+
+
+async def retry_tick():
+    await clock.sleep(0.4)  # ownership explicit at the seam
+
+
+def schedule_delivery(loop, fn):
+    # pbftlint: disable=PBL007 -- feeds call_at on the SAME loop: the virtualized timebase itself
+    target = loop.time() + 0.5
+    loop.call_at(target, fn)

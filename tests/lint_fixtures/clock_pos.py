@@ -1,0 +1,25 @@
+# pbftlint: clock-injectable
+"""PBL007 positive: every raw-clock class that bypasses the seam."""
+
+import asyncio
+import time
+
+
+def cooldown_stamp():
+    return time.monotonic()  # deadline math invisible to virtual time
+
+
+def latency_anchor():
+    return time.perf_counter()  # same class, different spelling
+
+
+def wall_stamp():
+    return time.time()  # wall read (also a PBL002 concern elsewhere)
+
+
+async def retry_tick():
+    await asyncio.sleep(0.4)  # must be clock.sleep at the seam
+
+
+def loop_read(loop):
+    return loop.time()  # raw loop-time read outside the call_at idiom

@@ -361,3 +361,49 @@ def test_audited_entries_bound_to_real_defs():
             f"audited entry ({root}.{term}) -> {owner}:{cls}.{name} "
             "no longer exists; re-audit and update AUDITED_NO_RAISE"
         )
+
+
+# ---------------------------------------------------------------------------
+# PBL007 clock seam (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_clock_seam_positive():
+    res = run("clock_pos.py")
+    assert set(codes(res)) == {"PBL007"}
+    details = {f.detail for f in res["findings"]}
+    assert details == {
+        "time.monotonic", "time.perf_counter", "time.time",
+        "asyncio.sleep", "loop.time",
+    }
+
+
+def test_clock_seam_negative():
+    # seam-compliant forms pass; the call_at idiom rides a justified
+    # suppression (counted, not a finding)
+    res = run("clock_neg.py")
+    assert codes(res) == []
+    assert len(res["suppressed"]) == 1
+
+
+def test_clock_seam_scope_is_opt_in():
+    # raw clocks OUTSIDE a clock-injectable module are not PBL007's
+    # business (engine/tool modules measure; they don't run timers the
+    # simulation must control)
+    res = run("loop_neg.py")
+    assert "PBL007" not in codes(res)
+
+
+def test_clock_seam_covers_the_injectable_surface():
+    """The scoped module list must keep naming the modules the sim
+    runtime actually drives — deleting one from the checker would
+    silently un-gate its timers."""
+    from tools.pbftlint.checks import clock_seam
+
+    assert set(clock_seam.SCOPED) >= {
+        "simple_pbft_tpu/consensus/replica.py",
+        "simple_pbft_tpu/consensus/statesync.py",
+        "simple_pbft_tpu/client.py",
+        "simple_pbft_tpu/telemetry.py",
+        "simple_pbft_tpu/faults.py",
+    }

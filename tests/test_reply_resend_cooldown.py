@@ -10,6 +10,7 @@ import asyncio
 
 from simple_pbft_tpu.committee import LocalCommittee
 from simple_pbft_tpu.messages import Message, Reply, Request
+from simple_pbft_tpu.sim import sim_run
 
 
 class CapturingTransport:
@@ -25,7 +26,10 @@ class CapturingTransport:
 
 
 def run(coro, timeout=30):
-    return asyncio.run(asyncio.wait_for(coro, timeout))
+    # virtual clock (ISSUE 13 satellite): the cooldown window is a real
+    # timer now testable by SLEEPING through it (virtually, instantly)
+    # instead of reaching into the replica's cooldown map
+    return sim_run(asyncio.wait_for(coro, timeout))
 
 
 def test_cached_reply_resend_cooldown():
@@ -51,9 +55,13 @@ def test_cached_reply_resend_cooldown():
         assert len(cap.sent) == 1
         assert rep.metrics["reply_resend_squelched"] == 2
 
-        rep._reply_resent[("c0", 7)] -= 2.0  # age the window out
+        await asyncio.sleep(1.2)  # virtual: age the 1 s window out
         await rep._on_request(req)  # next retry wave: answered again
         assert len(cap.sent) == 2
+        # and the squelch re-engages inside the fresh window
+        await rep._on_request(req)
+        assert len(cap.sent) == 2
+        assert rep.metrics["reply_resend_squelched"] == 3
 
         await com.stop()
 

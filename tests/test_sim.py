@@ -1,0 +1,361 @@
+"""Deterministic simulation runtime (ISSUE 13): virtual clock, trace
+determinism, virtual-vs-wall equivalence, oracles, the schedule
+minimizer, and the checked-in search-found repro artifacts.
+
+Everything here runs in VIRTUAL time (sim_run): minutes of scenario
+burn milliseconds of wall clock, and a saturated CI host cannot shift
+any timer — the interleavings are a pure function of the seeds."""
+
+import asyncio
+import json
+import os
+import time
+from dataclasses import replace
+
+import pytest
+
+from simple_pbft_tpu import clock
+from simple_pbft_tpu.faults import FaultEvent, FaultSchedule
+from simple_pbft_tpu.sim import (
+    SIM_START,
+    Scenario,
+    SimLoop,
+    SimStall,
+    minimize,
+    run_scenario,
+    scenario_from_artifact,
+    sim_run,
+)
+
+REPROS = os.path.join(os.path.dirname(__file__), "sim_repros")
+
+
+def load_repro(name):
+    with open(os.path.join(REPROS, name)) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# the virtual clock itself
+# ---------------------------------------------------------------------------
+
+
+def test_virtual_time_jumps_instead_of_sleeping():
+    async def main():
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        await asyncio.sleep(300)  # five virtual minutes
+        await clock.sleep(45)
+        return loop.time() - t0
+
+    w0 = time.monotonic()
+    elapsed = sim_run(main())
+    wall = time.monotonic() - w0
+    assert elapsed == pytest.approx(345.0, abs=1e-6)
+    assert wall < 5.0  # 345 virtual seconds for ~free
+
+
+def test_clock_seam_modes():
+    # wall mode: now() is a plain monotonic read
+    assert not clock.simulated()
+    assert abs(clock.now() - time.monotonic()) < 1.0
+
+    async def main():
+        assert clock.simulated()
+        loop = asyncio.get_running_loop()
+        assert clock.now() == loop.time()
+        # off_thread runs INLINE under simulation (no thread race
+        # against virtual time) — observable via thread identity
+        import threading
+
+        tid = await clock.off_thread(threading.get_ident)
+        assert tid == threading.get_ident()
+        # timestamps derive from virtual time against a fixed epoch
+        ts1 = clock.timestamp_us()
+        await clock.sleep(1.0)
+        ts2 = clock.timestamp_us()
+        assert ts2 - ts1 == pytest.approx(1_000_000, abs=2)
+
+    sim_run(main())
+    assert not clock.simulated()  # restored after the run
+
+
+def test_timer_ordering_is_preserved():
+    fired = []
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        loop.call_later(2.0, fired.append, "b")
+        loop.call_later(1.0, fired.append, "a")
+        loop.call_later(3.0, fired.append, "c")
+        await asyncio.sleep(5.0)
+
+    sim_run(main())
+    assert fired == ["a", "b", "c"]
+
+
+def test_sim_stall_guard():
+    async def wedge():
+        await asyncio.get_running_loop().create_future()  # never set
+
+    with pytest.raises(SimStall):
+        sim_run(wedge())
+
+
+def test_wall_timeout_guard():
+    async def runaway():
+        while True:  # infinite virtual events: no virtual bound trips
+            await asyncio.sleep(0.01)
+
+    with pytest.raises(SimStall, match="wall timeout"):
+        sim_run(runaway(), wall_timeout=1.0)
+
+
+# ---------------------------------------------------------------------------
+# trace determinism (acceptance: same seed => byte-identical trace)
+# ---------------------------------------------------------------------------
+
+STORM = dict(
+    n=4, requests=8, horizon=10.0, probes=2,
+    gen=dict(crashes=1, partition_windows=1, drop_windows=1),
+)
+
+
+def test_same_seed_byte_identical_trace():
+    sc = Scenario(seed=11, **STORM)
+    a = run_scenario(sc)
+    b = run_scenario(sc)
+    assert a.fingerprint == b.fingerprint
+    assert a.coverage == b.coverage
+    assert a.schedule == b.schedule
+
+
+def test_different_seed_different_trace():
+    a = run_scenario(Scenario(seed=11, **STORM))
+    b = run_scenario(Scenario(seed=12, **STORM))
+    assert a.fingerprint != b.fingerprint
+
+
+def test_faulty_scenario_oracles_hold():
+    res = run_scenario(Scenario(seed=11, **STORM))
+    assert res.ok, res.failure
+    assert res.coverage["crashes"] == 1
+    assert res.committed > 0
+
+
+def test_equivocating_primary_convicted_under_sim():
+    """The audit plane works inside the simulation: a byzantine
+    injector's forks are observed, safety holds, and the violations
+    land on the INJECTED target only."""
+    res = run_scenario(Scenario(
+        seed=5, n=4, requests=8, horizon=10.0, probes=1,
+        gen=dict(equivocators=1), verify_signatures=True,
+    ))
+    assert res.ok, res.failure  # divergence would be a safety failure
+    assert res.byzantine  # the injector armed
+    assert res.coverage["violations"] > 0  # ...and was caught
+
+
+# ---------------------------------------------------------------------------
+# virtual-vs-wall equivalence (acceptance)
+# ---------------------------------------------------------------------------
+
+
+def test_virtual_vs_wall_equivalence():
+    """The same fault-free scenario under the virtual clock and under
+    the real clock commits the same operation sequence to the same
+    application state — simulation changes TIME, not the protocol."""
+    from simple_pbft_tpu.sim import SimTrace, _drive
+
+    sc = Scenario(seed=3, n=4, requests=5, horizon=3.0, probes=1,
+                  drain=20.0, probe_patience=20.0)
+
+    def wall_run():
+        async def main():
+            loop = asyncio.get_running_loop()
+            return await _drive(sc, SimTrace(loop, base=loop.time()))
+
+        return asyncio.run(main())
+
+    async def sim_main():
+        loop = asyncio.get_running_loop()
+        return await _drive(sc, SimTrace(loop, base=SIM_START))
+
+    wall = wall_run()
+    sim = sim_run(sim_main())
+    assert wall.ok and sim.ok, (wall.failure, sim.failure)
+    # same per-replica application outcome (digests computed over the
+    # final KV state) and the same commit count
+    assert wall.app_digests == sim.app_digests
+    assert wall.committed == sim.committed
+    # both runs' honest replicas agreed internally (the safety oracle
+    # passed in both worlds)
+    assert wall.coverage["violations"] == sim.coverage["violations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# wall-clock compression (acceptance: wan3dc minutes -> seconds)
+# ---------------------------------------------------------------------------
+
+
+def test_wan3dc_compression():
+    """An n=7 wan3dc committee with a partition healing mid-run — the
+    scenario class that costs minutes of WALL time in the wan-smoke CI
+    job — finishes in seconds of wall clock under the virtual clock,
+    having simulated the full virtual horizon."""
+    sc = Scenario(
+        seed=9, n=7, requests=10, horizon=45.0, probes=2,
+        gen=dict(wan="wan3dc", partition_windows=1, crashes=1),
+    )
+    w0 = time.monotonic()
+    res = run_scenario(sc)
+    wall = time.monotonic() - w0
+    assert res.ok, res.failure
+    assert res.vtime_s >= 45.0  # the whole horizon was simulated
+    assert wall < 30.0  # seconds of wall for minutes of virtual time
+    assert res.committed > 0
+
+
+# ---------------------------------------------------------------------------
+# replay tuple (satellite: summary <-> from_summary)
+# ---------------------------------------------------------------------------
+
+
+def test_schedule_summary_replay_tuple():
+    s = FaultSchedule.parse(
+        "seed=9,crashes=2,partition=2.0:r0|r1<>r2|r3:1.5,shape=lossy",
+        horizon=20.0, replica_ids=["r0", "r1", "r2", "r3"],
+    )
+    doc = s.summary()
+    # the complete replay tuple rides every ledger line
+    assert doc["schema"] == FaultSchedule.SUMMARY_SCHEMA
+    assert doc["seed"] == 9 and doc["horizon_s"] == 20.0
+    assert isinstance(doc["kinds_crc"], int)
+    assert len(doc["events"]) == len(s.events)
+    # reconstruction is a fixed point of the wire form
+    r = FaultSchedule.from_summary(doc)
+    assert r.summary() == doc
+    assert [e.kind for e in r.events] == [e.kind for e in s.events]
+    # and a drifted kind registry fails loudly instead of lying
+    bad = dict(doc, events=[{"t": 1.0, "kind": "not_a_kind"}])
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSchedule.from_summary(bad)
+
+
+# ---------------------------------------------------------------------------
+# minimizer (acceptance: known-bad schedule shrinks to <= a fixed count)
+# ---------------------------------------------------------------------------
+
+
+def test_minimizer_shrinks_known_bad_schedule():
+    """Start from the checked-in slow-failover repro (2 essential
+    events at a tightened patience) buried under noise events; ddmin
+    must strip the noise back down while preserving the failure."""
+    doc = load_repro("slow_failover_tail.json")
+    base = scenario_from_artifact(doc)
+    # tighten the oracle so the KNOWN tail counts as the failure under
+    # minimization (the production oracle hunts wedges; this test hunts
+    # the minimizer's convergence)
+    base = replace(base, probe_patience=90.0, probes=1, drain=30.0)
+    noisy = list(base.schedule.events) + [
+        FaultEvent(t=5.0, kind="drop_window", duration=2.0, magnitude=0.01),
+        FaultEvent(t=20.0, kind="delay_window", duration=2.0,
+                   magnitude=0.01),
+        FaultEvent(t=55.0, kind="heal"),
+    ]
+    sc = replace(base, schedule=FaultSchedule(
+        seed=base.schedule.seed, horizon=base.schedule.horizon,
+        events=tuple(sorted(noisy, key=lambda e: (e.t, e.kind))),
+    ))
+    assert not run_scenario(sc).ok  # still failing with the noise
+    min_sc, min_res, runs = minimize(sc, max_runs=60)
+    assert not min_res.ok
+    assert len(min_sc.schedule.events) <= 4  # the fixed-count bound
+    assert runs <= 60
+
+
+# ---------------------------------------------------------------------------
+# checked-in search-found repros (acceptance: found by search, minimized,
+# regression-tested)
+# ---------------------------------------------------------------------------
+
+
+def test_slow_failover_tail_repro_converges_but_slowly():
+    """The coverage-guided search found (and ddmin minimized) a
+    crash+partition interleaving that parks every live replica on a
+    crashed primary's target view for MINUTES of virtual time (the
+    backoff ladder retransmits-then-escalates at 60 s rungs). It
+    converges — so the wedge oracle, calibrated at 600 s, passes it —
+    but the recovery-latency coverage signal must keep seeing it, and
+    this replay pins the tail so a future ladder fix shows up as this
+    assertion flipping to 'fast'. Triage: docs/SCENARIOS.md."""
+    sc = scenario_from_artifact(load_repro("slow_failover_tail.json"))
+    # the artifact records the patience the search ran at (300 s, still
+    # inside the tail); judge convergence at the calibrated wedge bound
+    res = run_scenario(replace(sc, probe_patience=600.0))
+    assert res.ok, res.failure  # converges within the wedge oracle
+    assert res.coverage["probe_s"] > 90  # ...but pathologically slowly
+
+
+def test_planted_defect_wedge_repro():
+    """End-to-end proof the search loop finds real bugs: the
+    sync_abandon_leak defect (a once-real PR 7 wedge, re-armable via
+    statesync.DEFECTS) was found by coverage-guided search — NOT by a
+    hand-written scenario — minimized, and checked in. With the defect
+    armed the minimized schedule wedges the committee (statesync
+    abandons, pending_sync leaks, the dedup guard swallows every
+    re-trigger); on the FIXED code the same schedule passes."""
+    doc = load_repro("sync_abandon_wedge.json")
+    sc = scenario_from_artifact(doc)
+    assert "sync_abandon_leak" in sc.defects  # recorded as found
+    wedged = run_scenario(sc)
+    assert not wedged.ok
+    assert wedged.failure_class == "liveness"
+    # the same schedule on the fixed code: no wedge
+    fixed = run_scenario(replace(sc, defects=()))
+    assert fixed.ok, fixed.failure
+
+
+# ---------------------------------------------------------------------------
+# explorer plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_explorer_sweep_smoke(tmp_path):
+    """A tiny in-process sweep: deterministic selfcheck passes, runs
+    complete, coverage keys accumulate."""
+    import argparse
+
+    from tools import sim_explore
+
+    args = argparse.Namespace(
+        mode="sweep", runs=4, seed_base=77, search_seed=1, n=4,
+        clients=1, requests=6, horizon=6.0, probes=1, view_timeout=1.0,
+        checkpoint_interval=8, watermark_window=32, signed=False,
+        qc=False, defect=None, selfcheck=2, audit_every=0,
+        max_failures=1, minimize_budget=10, out=str(tmp_path),
+        progress=False,
+    )
+    stats = sim_explore.mode_sweep(args)
+    assert stats["runs"] == 6  # 4 + 2 selfcheck re-runs
+    assert stats["selfcheck_ok"] is True
+    assert stats["failures"] == []
+    assert len(stats["coverage_keys"]) >= 1
+
+
+def test_explorer_mutations_stay_in_registry():
+    """Every mutated schedule round-trips through the replay tuple —
+    mutation can never invent an event the kind registry (and so a
+    ledger replay) does not understand."""
+    import random
+
+    from tools import sim_explore
+
+    rng = random.Random(3)
+    ids = ("r0", "r1", "r2", "r3")
+    sched = FaultSchedule.generate(seed=1, horizon=30.0, crashes=1,
+                                   partition_windows=1, replica_ids=ids)
+    for _ in range(60):
+        sched = sim_explore.mutate(rng, sched, ids)
+        FaultSchedule.from_summary(sched.summary())  # must not raise
+    assert all(0 <= e.t <= 0.9 * 30.0 for e in sched.events)

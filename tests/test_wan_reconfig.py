@@ -23,6 +23,7 @@ import sys
 import pytest
 
 from simple_pbft_tpu.app import KVStore
+from simple_pbft_tpu import clock as pbft_clock
 from simple_pbft_tpu.committee import LocalCommittee
 from simple_pbft_tpu.client import Client
 from simple_pbft_tpu.config import KeyPair, make_test_committee
@@ -1000,7 +1001,7 @@ class TestScheduleDrivenStaleVoter:
             )
             injector = FaultInjector(committee=com, schedule=schedule)
             try:
-                await injector.run(time_mod.perf_counter() + 0.5)
+                await injector.run(pbft_clock.now() + 0.5)
                 removed = com.replica("r4")
                 assert removed.refuse_retirement
                 assert isinstance(removed.transport, StaleEpochVoter)

@@ -10,6 +10,7 @@ from .. import callgraph
 from ..core import Finding, Module
 
 from . import (  # noqa: E402
+    clock_seam,
     determinism,
     drift,
     exception_safety,
@@ -17,7 +18,8 @@ from . import (  # noqa: E402
     shape_stability,
 )
 
-ALL = (loop_blocking, determinism, drift, exception_safety, shape_stability)
+ALL = (loop_blocking, determinism, drift, exception_safety,
+       shape_stability, clock_seam)
 
 
 def run_all(mods: List[Module]) -> List[Finding]:

@@ -1,0 +1,123 @@
+"""PBL007 — raw clock reads bypassing the injectable clock seam.
+
+Historical bug class this encodes (ISSUE 13): the deterministic
+simulation runtime virtualizes the event loop's clock, but any timer
+DECISION held in a plain float — a cooldown map stamped with
+``time.monotonic()``, a deadline computed from ``perf_counter()`` — is
+invisible to the loop. Under a compressed virtual clock such a site
+silently freezes (cooldowns never expire: the reply-resend squelch
+would drop every retransmission forever) or starves (deadlines never
+arrive: the statesync retry tick would never rotate peers). The fix is
+the seam: ``clock.now()`` / ``clock.sleep()`` / ``clock.timestamp_us()``
+/ ``clock.off_thread()`` (simple_pbft_tpu/clock.py), which the sim
+runtime redirects onto virtual time.
+
+Scoped to the clock-injectable modules (the ones the simulation drives
+end to end). In them the checker flags:
+
+- ``time.monotonic()`` / ``time.perf_counter()`` — deadline/interval
+  reads that must come from ``clock.now()``;
+- ``time.time()`` — wall reads (also a PBL002 concern in deterministic
+  modules); human-facing timestamps get a justified suppression;
+- ``asyncio.sleep(...)`` — must be ``clock.sleep(...)`` so the sleep's
+  ownership is explicit at the seam;
+- ``<...>loop.time()`` — loop-time reads outside the ``call_at``
+  scheduling idiom (sites that legitimately feed ``call_at`` carry a
+  justified suppression).
+
+Modules outside the built-in scope opt in with a header marker:
+``# pbftlint: clock-injectable``. Engine/tool modules (crypto kernels,
+offline CLIs) are deliberately out of scope: their clock reads are
+measurements, not protocol timers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .. import callgraph
+from ..core import Finding, Module
+
+CODE = "PBL007"
+
+# the clock-injectable surface: every module whose timers the
+# simulation runtime must control (ISSUE 13 tentpole)
+SCOPED = (
+    "simple_pbft_tpu/consensus/replica.py",
+    "simple_pbft_tpu/consensus/statesync.py",
+    "simple_pbft_tpu/consensus/viewchange.py",
+    "simple_pbft_tpu/client.py",
+    "simple_pbft_tpu/telemetry.py",
+    "simple_pbft_tpu/faults.py",
+)
+
+MARKER = "pbftlint: clock-injectable"
+
+BANNED = {
+    "time.monotonic": "clock.now()",
+    "time.perf_counter": "clock.now()",
+    "time.time": "clock.timestamp_us() (or a justified suppression for "
+                 "human-facing wall timestamps)",
+    "asyncio.sleep": "clock.sleep()",
+}
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, mod: Module) -> None:
+        self.mod = mod
+        self.scope: List[str] = []
+        self.findings: List[Finding] = []
+
+    def _add(self, node: ast.AST, detail: str, message: str) -> None:
+        self.findings.append(
+            Finding(
+                code=CODE,
+                path=self.mod.path,
+                line=getattr(node, "lineno", 1),
+                scope=".".join(self.scope),
+                detail=detail,
+                message=message,
+            )
+        )
+
+    def visit_FunctionDef(self, node) -> None:
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = callgraph.dotted(node.func)
+        if name in BANNED:
+            self._add(
+                node,
+                name,
+                f"{name}() bypasses the injectable clock seam in a "
+                f"clock-injectable module — under simulation this timer "
+                f"site freezes or starves against virtual time; use "
+                f"{BANNED[name]} (simple_pbft_tpu/clock.py)",
+            )
+        elif name and name.endswith("loop.time"):
+            self._add(
+                node,
+                "loop.time",
+                "raw loop.time() read in a clock-injectable module — "
+                "use clock.now() (same timebase under simulation), or "
+                "suppress with a why when the value feeds call_at on "
+                "the same loop",
+            )
+        self.generic_visit(node)
+
+
+def check(mods: List[Module], graph: callgraph.CallGraph) -> List[Finding]:
+    out: List[Finding] = []
+    for m in mods:
+        if m.path not in SCOPED and MARKER not in "\n".join(m.lines[:30]):
+            continue
+        v = _Visitor(m)
+        v.visit(m.tree)
+        out.extend(v.findings)
+    return out

@@ -1,0 +1,495 @@
+#!/usr/bin/env python
+"""Coverage-guided schedule search over the deterministic simulation
+runtime (ISSUE 13).
+
+Thousands of seeded scenario executions per invocation, three modes:
+
+- ``--mode sweep``: N independent seeded scenarios (schedules generated
+  from the seed, no mutation) — the tier-1 ``sim-smoke`` shape. With
+  ``--selfcheck K``, the first K seeds run TWICE and their event-trace
+  fingerprints must match byte for byte (the replay-determinism
+  acceptance gate). With ``--audit-every K``, every Kth scenario runs
+  signature-verified with auditor ledgers on disk and must earn a
+  ``tools/ledger_audit.py`` clean bill (exit 0).
+
+- ``--mode search``: coverage-guided mutation. A corpus of schedules
+  grows on NOVEL coverage signatures (phases reached, view changes,
+  statesync rounds/restarts/aborts, epochs, audit observations —
+  sim.coverage_key); parents are drawn biased toward rare signatures
+  and mutated (add/extend/shift/retarget/drop partition, crash, WAN
+  shape events), steering runs toward rare interleavings like
+  partition-during-statesync-during-view-change. Any oracle failure
+  (safety divergence, unexpected audit evidence, liveness probe
+  timeout) is delta-debugged to a minimal event list (sim.minimize) and
+  written as a replayable repro artifact.
+
+- ``--replay ARTIFACT``: re-run a repro artifact and report whether the
+  recorded failure reproduces.
+
+Every run is a pure function of (scenario family flags, seed): the
+search RNG, the schedules, the virtual clock, and the committee are all
+seeded, so an invocation reproduces end to end.
+
+Planted-defect validation (the search must be able to find real bugs):
+``--defect sync_abandon_leak`` re-arms a known-fixed statesync wedge
+(simple_pbft_tpu/consensus/statesync.DEFECTS) and the search is
+expected to FIND it — CI asserts exactly that, and the minimized
+artifact it produced is checked in as tests/sim_repros/ with a
+regression test replaying it against the fixed code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+from dataclasses import replace
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from simple_pbft_tpu.faults import FaultEvent, FaultSchedule  # noqa: E402
+from simple_pbft_tpu.sim import (  # noqa: E402
+    Scenario,
+    SimResult,
+    artifact_doc,
+    coverage_key,
+    minimize,
+    run_scenario,
+    scenario_from_artifact,
+)
+
+# ---------------------------------------------------------------------------
+# scenario family
+# ---------------------------------------------------------------------------
+
+
+def base_scenario(args, seed: int) -> Scenario:
+    return Scenario(
+        seed=seed,
+        n=args.n,
+        clients=args.clients,
+        requests=args.requests,
+        horizon=args.horizon,
+        probes=args.probes,
+        checkpoint_interval=args.checkpoint_interval,
+        watermark_window=args.watermark_window,
+        view_timeout=args.view_timeout,
+        verify_signatures=args.signed,
+        qc_mode=args.qc,
+        defects=tuple(args.defect or ()),
+    )
+
+
+def sample_gen(rng: random.Random, signed: bool) -> Dict[str, object]:
+    """Random generate() kwargs for a fresh corpus seed: light faulting,
+    weighted toward the network kinds the search mutates well."""
+    gen: Dict[str, object] = {}
+    gen["crashes"] = rng.choice((0, 0, 1, 1, 2))
+    gen["partition_windows"] = rng.choice((0, 1, 1, 2))
+    gen["drop_windows"] = rng.choice((0, 0, 1))
+    if rng.random() < 0.15:
+        gen["wan"] = rng.choice(("wan3dc", "lossy"))
+    if signed and rng.random() < 0.2:
+        gen[rng.choice(("equivocators", "checkpoint_forkers"))] = 1
+    return gen
+
+
+# ---------------------------------------------------------------------------
+# schedule mutation
+# ---------------------------------------------------------------------------
+
+
+def _rand_groups(rng: random.Random, ids: Tuple[str, ...]) -> str:
+    """A random minority-vs-rest split with a random direction. The
+    asymmetric arrows matter: 'inbound-cut then outbound-cut of the
+    same replica' is exactly the statesync-starvation shape."""
+    k = max(1, rng.randint(1, max(1, len(ids) // 3)))
+    cut = rng.sample(list(ids), k)
+    rest = [i for i in ids if i not in cut]
+    arrow = rng.choice((">", ">", "<>"))
+    a, b = ("|".join(cut), "|".join(rest) or "*")
+    if rng.random() < 0.5:
+        a, b = b, a
+    return f"{a}{arrow}{b}"
+
+
+def mutate(
+    rng: random.Random, sched: FaultSchedule, ids: Tuple[str, ...]
+) -> FaultSchedule:
+    """One mutation step over the event list. Times/durations stay
+    inside the horizon; durations may grow LONG (up to 0.85h) — rare
+    wedges live behind windows the generator's 0.15h cap never deals."""
+    h = sched.horizon
+    events: List[FaultEvent] = list(sched.events)
+    ops = ["add_partition", "add_crash", "shift", "drop", "extend",
+           "retime_dup", "flip_chain"]
+    if not events:
+        ops = ["add_partition", "add_crash"]
+    op = rng.choice(ops)
+    if op == "flip_chain":
+        # structured operator: take an existing cut and OVERLAP its
+        # complementary direction on one member — "hear but can't
+        # speak" / "speak but can't hear" phases chained on the same
+        # replica are where transfer/starvation interleavings live,
+        # and independent random cuts essentially never compose them
+        parts = [e for e in events if e.kind == "partition" and e.spec]
+        if not parts:
+            op = "add_partition"
+        else:
+            e = rng.choice(parts)
+            try:
+                from simple_pbft_tpu.faults import parse_partition_spec
+
+                srcs, dsts, _sym = parse_partition_spec(e.spec, ids)
+            except ValueError:
+                srcs, dsts = set(), set()
+            side = srcs if len(srcs) <= len(dsts) else dsts
+            target = rng.choice(sorted(side or set(ids)))
+            rest = "|".join(i for i in ids if i != target) or "*"
+            spec = (f"{target}>{rest}" if rng.random() < 0.5
+                    else f"{rest}>{target}")
+            start = e.t + max(e.duration, 0.05 * h) * rng.uniform(0.3, 1.1)
+            events.append(FaultEvent(
+                t=round(min(0.85 * h, start), 3),
+                kind="partition", spec=spec,
+                duration=round(rng.uniform(0.3 * h, 0.85 * h), 3),
+            ))
+            events.sort(key=lambda ev: (ev.t, ev.kind, ev.target, ev.spec))
+            return FaultSchedule(seed=sched.seed, horizon=h,
+                                 events=tuple(events))
+    if op == "add_partition":
+        events.append(FaultEvent(
+            t=round(rng.uniform(0.03 * h, 0.8 * h), 3),
+            kind="partition",
+            spec=_rand_groups(rng, ids),
+            duration=round(rng.uniform(0.05 * h, 0.85 * h), 3),
+        ))
+    elif op == "add_crash":
+        target = rng.choice(["", *ids])
+        events.append(FaultEvent(
+            t=round(rng.uniform(0.1 * h, 0.85 * h), 3),
+            kind="crash", target=target,
+        ))
+    elif op == "shift" and events:
+        i = rng.randrange(len(events))
+        e = events[i]
+        events[i] = replace_event(
+            e, t=round(min(0.9 * h, max(0.0, e.t + rng.uniform(-0.2 * h, 0.2 * h))), 3)
+        )
+    elif op == "drop" and events:
+        events.pop(rng.randrange(len(events)))
+    elif op == "extend" and events:
+        cands = [i for i, e in enumerate(events) if e.duration > 0]
+        if cands:
+            i = rng.choice(cands)
+            e = events[i]
+            events[i] = replace_event(
+                e, duration=round(min(0.9 * h, e.duration * rng.uniform(1.5, 4.0)), 3)
+            )
+    elif op == "retime_dup" and events:
+        e = events[rng.randrange(len(events))]
+        events.append(replace_event(
+            e, t=round(rng.uniform(0.03 * h, 0.85 * h), 3)
+        ))
+    events.sort(key=lambda e: (e.t, e.kind, e.target, e.spec))
+    return FaultSchedule(seed=sched.seed, horizon=h, events=tuple(events))
+
+
+def replace_event(e: FaultEvent, **kw) -> FaultEvent:
+    d = dict(t=e.t, kind=e.kind, target=e.target, duration=e.duration,
+             magnitude=e.magnitude, spec=e.spec)
+    d.update(kw)
+    return FaultEvent(**d)
+
+
+# ---------------------------------------------------------------------------
+# oracles beyond the in-process ones: ledger_audit clean bill
+# ---------------------------------------------------------------------------
+
+
+def audited_run(sc: Scenario) -> Tuple[SimResult, Optional[int]]:
+    """Run signature-verified with auditor ledgers on disk, then join
+    them with tools/ledger_audit.py. Returns (result, audit_exit) —
+    audit_exit 0 is the clean bill; byzantine schedules legitimately
+    exit 1 WITH the injected target accused (that is the audit plane
+    working, not a failure)."""
+    from tools import ledger_audit
+
+    from simple_pbft_tpu.config import make_test_committee
+
+    cfg, _keys = make_test_committee(
+        n=sc.n, clients=sc.clients, qc_mode=sc.qc_mode
+    )
+    with tempfile.TemporaryDirectory(prefix="sim_audit_") as d:
+        res = run_scenario(replace(
+            sc, verify_signatures=True, audit_dir=d
+        ))
+        report, code = ledger_audit.run_audit([d], cfg=cfg)
+        accused = set(report.get("accused") or [])
+        if code == 2:
+            res = replace(res, ok=False, failure="audit:corrupt-ledger")
+        elif code == 1 and not accused <= set(res.byzantine):
+            res = replace(
+                res, ok=False,
+                failure=f"audit:honest-accused:{sorted(accused)}",
+            )
+        return res, code
+
+
+# ---------------------------------------------------------------------------
+# the drivers
+# ---------------------------------------------------------------------------
+
+
+def handle_failure(args, sc: Scenario, res: SimResult, tag: str,
+                   stats: Dict) -> None:
+    """Minimize a failing scenario and write the repro artifact (round-
+    trip verified: the artifact is re-run from its own JSON before it is
+    written, so a checked-in repro always replays)."""
+    print(f"[sim_explore] FAILURE {res.failure} (schedule "
+          f"{len(res.schedule['events'])} events) — minimizing...")
+    try:
+        min_sc, min_res, runs = minimize(
+            sc, max_runs=args.minimize_budget,
+            progress=lambda m: print(f"  [minimize] {m}"),
+        )
+    except ValueError:
+        # flaky-by-schedule (should not happen: runs are deterministic)
+        min_sc, min_res, runs = sc, res, 0
+    # round-trip: rebuild from the artifact doc and confirm the failure
+    doc = artifact_doc(min_sc, min_res)
+    replay_sc = scenario_from_artifact(doc)
+    replay_res = run_scenario(replay_sc)
+    if replay_res.failure_class != (min_res.failure_class or ""):
+        # keep the unrounded version's verdict (already in doc), noting
+        # that the rounded round-trip disagreed
+        doc["replay_note"] = (
+            f"rounded replay produced {replay_res.failure!r}"
+        )
+    else:
+        doc = artifact_doc(replay_sc, replay_res)
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, f"repro_{tag}.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+    ev = len(min_res.schedule["events"])
+    print(f"[sim_explore] minimized to {ev} events in {runs} runs -> {path}")
+    stats["failures"].append({
+        "failure": min_res.failure,
+        "artifact": path,
+        "events": ev,
+        "minimize_runs": runs,
+    })
+
+
+def mode_sweep(args) -> Dict:
+    stats: Dict = {"mode": "sweep", "runs": 0, "failures": [],
+                   "coverage_keys": {}, "selfcheck_ok": None,
+                   "audits": 0, "audit_clean": 0}
+    t0 = time.monotonic()
+    mismatches = []
+    for i in range(args.runs):
+        seed = args.seed_base + i
+        sc = base_scenario(args, seed)
+        sc = replace(sc, gen=sample_gen(random.Random(seed ^ 0xC0FFEE),
+                                        args.signed))
+        if args.audit_every and i % args.audit_every == 0:
+            res, code = audited_run(sc)
+            stats["audits"] += 1
+            if code == 0 or (code == 1 and res.ok):
+                stats["audit_clean"] += 1
+        else:
+            res = run_scenario(sc)
+        stats["runs"] += 1
+        if args.selfcheck and i < args.selfcheck:
+            res2 = run_scenario(sc)
+            stats["runs"] += 1
+            if res.fingerprint != res2.fingerprint:
+                mismatches.append(seed)
+        key = str(coverage_key(res.coverage))
+        stats["coverage_keys"][key] = stats["coverage_keys"].get(key, 0) + 1
+        if not res.ok:
+            handle_failure(args, sc, res, f"sweep_seed{seed}", stats)
+        if args.progress and (i + 1) % 50 == 0:
+            dt = time.monotonic() - t0
+            print(f"[sim_explore] {i + 1}/{args.runs} runs, "
+                  f"{len(stats['coverage_keys'])} coverage keys, "
+                  f"{len(stats['failures'])} failures, "
+                  f"{(i + 1) / dt:.1f} runs/s")
+    stats["selfcheck_ok"] = not mismatches
+    stats["selfcheck_mismatches"] = mismatches
+    stats["wall_s"] = round(time.monotonic() - t0, 2)
+    return stats
+
+
+def mode_search(args) -> Dict:
+    stats: Dict = {"mode": "search", "runs": 0, "failures": [],
+                   "coverage_keys": {}, "corpus": 0}
+    rng = random.Random(args.search_seed)
+    ids = tuple(f"r{i}" for i in range(args.n))
+    # corpus entries: (schedule, coverage_key)
+    corpus: List[Tuple[FaultSchedule, Tuple]] = []
+    key_counts: Dict[Tuple, int] = {}
+    t0 = time.monotonic()
+    for i in range(args.runs):
+        seed = args.seed_base + i
+        if corpus and rng.random() < 0.7:
+            # pick a parent, biased toward RARE coverage signatures
+            # quadratic rarity bias: a signature seen once is worth
+            # dwelling on; a saturated one barely draws mutations
+            weights = [1.0 / (key_counts[k] ** 2) for (_, k) in corpus]
+            parent = rng.choices(corpus, weights=weights, k=1)[0][0]
+            sched = mutate(rng, parent, ids)
+            for _ in range(rng.randrange(0, 2)):
+                sched = mutate(rng, sched, ids)
+        else:
+            gen = sample_gen(rng, args.signed)
+            sched = FaultSchedule.generate(
+                seed=seed, horizon=args.horizon, replica_ids=ids, **gen
+            )
+        sc = replace(base_scenario(args, seed), schedule=sched)
+        res = run_scenario(sc)
+        stats["runs"] += 1
+        key = coverage_key(res.coverage)
+        key_counts[key] = key_counts.get(key, 0) + 1
+        skey = str(key)
+        stats["coverage_keys"][skey] = stats["coverage_keys"].get(skey, 0) + 1
+        if key_counts[key] == 1:
+            corpus.append((sched, key))
+            if args.progress:
+                hot = {k: v for k, v in res.coverage.items() if v}
+                print(f"[sim_explore] run {i}: NEW coverage {skey} {hot}")
+        if not res.ok:
+            handle_failure(args, sc, res, f"search_{i}", stats)
+            if len(stats["failures"]) >= args.max_failures:
+                break
+        if args.progress and (i + 1) % 50 == 0:
+            dt = time.monotonic() - t0
+            print(f"[sim_explore] {i + 1}/{args.runs} runs, "
+                  f"corpus {len(corpus)}, "
+                  f"{len(stats['failures'])} failures, "
+                  f"{(i + 1) / dt:.1f} runs/s")
+    stats["corpus"] = len(corpus)
+    stats["wall_s"] = round(time.monotonic() - t0, 2)
+    return stats
+
+
+def mode_replay(args) -> Dict:
+    with open(args.replay) as f:
+        doc = json.load(f)
+    sc = scenario_from_artifact(doc)
+    if args.defect:
+        sc = replace(sc, defects=tuple(args.defect))
+    res = run_scenario(sc)
+    want = doc.get("failure")
+    reproduced = (res.failure_class or None) == (
+        want.split(":", 1)[0] if want else None
+    )
+    return {
+        "mode": "replay",
+        "artifact": args.replay,
+        "recorded_failure": want,
+        "replay_failure": res.failure,
+        "reproduced": reproduced,
+        "fingerprint": res.fingerprint,
+        "coverage": res.coverage,
+        "vtime_s": res.vtime_s,
+        "wall_s": res.wall_s,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--mode", choices=("sweep", "search"), default="sweep")
+    ap.add_argument("--runs", type=int, default=300)
+    ap.add_argument("--seed-base", type=int, default=10_000)
+    ap.add_argument("--search-seed", type=int, default=42,
+                    help="search-RNG seed: the whole exploration replays")
+    ap.add_argument("--n", type=int, default=4)
+    ap.add_argument("--clients", type=int, default=1)
+    ap.add_argument("--requests", type=int, default=10)
+    ap.add_argument("--horizon", type=float, default=12.0)
+    ap.add_argument("--probes", type=int, default=4)
+    ap.add_argument("--view-timeout", type=float, default=1.0)
+    ap.add_argument("--checkpoint-interval", type=int, default=8)
+    ap.add_argument("--watermark-window", type=int, default=32,
+                    help="small on purpose: watermark-edge wedges become "
+                         "reachable within a short horizon")
+    ap.add_argument("--signed", action="store_true",
+                    help="verify signatures (slower; enables the audit "
+                         "plane and byzantine injector kinds)")
+    ap.add_argument("--qc", action="store_true", help="BLS QC mode")
+    ap.add_argument("--defect", action="append", default=None,
+                    help="arm a planted defect knob (validation mode; "
+                         "repeatable). Known: sync_abandon_leak")
+    ap.add_argument("--selfcheck", type=int, default=0,
+                    help="run the first K sweep seeds twice and require "
+                         "byte-identical trace fingerprints")
+    ap.add_argument("--audit-every", type=int, default=0,
+                    help="every Kth sweep run is signature-verified with "
+                         "ledgers on disk and ledger_audit-joined")
+    ap.add_argument("--max-failures", type=int, default=3,
+                    help="stop the search after this many minimized repros")
+    ap.add_argument("--minimize-budget", type=int, default=120,
+                    help="max re-runs the minimizer may spend per failure")
+    ap.add_argument("--out", default="sim_repros",
+                    help="artifact directory for minimized repros")
+    ap.add_argument("--replay", default=None, metavar="ARTIFACT")
+    ap.add_argument("--expect-failure", action="store_true",
+                    help="validation mode (planted defect): exit 0 IFF "
+                         "the search found at least one failure")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--progress", action="store_true")
+    args = ap.parse_args()
+
+    if args.replay:
+        out = mode_replay(args)
+        print(json.dumps(out, indent=None if args.json else 2,
+                         sort_keys=True))
+        sys.exit(0 if out["reproduced"] else 1)
+
+    stats = mode_sweep(args) if args.mode == "sweep" else mode_search(args)
+    summary = {
+        "runs": stats["runs"],
+        "wall_s": stats.get("wall_s"),
+        "runs_per_s": round(
+            stats["runs"] / stats["wall_s"], 2
+        ) if stats.get("wall_s") else None,
+        "unique_coverage": len(stats["coverage_keys"]),
+        "failures": stats["failures"],
+        "selfcheck_ok": stats.get("selfcheck_ok"),
+        "audits": stats.get("audits"),
+        "audit_clean": stats.get("audit_clean"),
+        "corpus": stats.get("corpus"),
+        "mode": stats["mode"],
+    }
+    if args.json:
+        print(json.dumps(summary, sort_keys=True))
+    else:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    failed = bool(stats["failures"])
+    if stats.get("selfcheck_ok") is False:
+        print("[sim_explore] DETERMINISM VIOLATION: "
+              f"seeds {stats['selfcheck_mismatches']}", file=sys.stderr)
+        sys.exit(2)
+    if stats.get("audits") and stats["audits"] != stats.get("audit_clean"):
+        print("[sim_explore] ledger_audit clean-bill gate failed",
+              file=sys.stderr)
+        sys.exit(1)
+    if args.expect_failure:
+        sys.exit(0 if failed else 1)
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()
