@@ -173,6 +173,7 @@ async def run_config(
     flight_dir: str = None,
     trace_sample: float = 0,
     stall_deadline: float = 30.0,
+    device_profile: float = 0.0,
 ) -> dict:
     from simple_pbft_tpu.committee import LocalCommittee
     from simple_pbft_tpu.crypto.coalesce import VerifyService
@@ -374,6 +375,17 @@ async def run_config(
         os.path.join(flight_dir, f"{name}.spans.jsonl")
         if flight_dir else None,
     )
+    # device-plane observatory (ISSUE 14): reset the per-dispatch device
+    # ledger in lockstep with spans — after warm, per cell — so each
+    # cell's rec["device"] aggregates describe that cell's window alone
+    # and tools/verify_observatory.py can reconcile ledger vs spans
+    from simple_pbft_tpu import devledger as devledger_mod
+
+    devledger_mod.configure(name)
+    if device_profile > 0 and flight_dir:
+        devledger_mod.arm_profile(
+            os.path.join(flight_dir, "device_profile"), device_profile
+        )
     sample_mod = resolve_sample_mod(trace_sample)
     if sample_mod > 0:
         tracers = com.attach_tracers(
@@ -711,6 +723,12 @@ async def run_config(
     # event-loop lag gauge (a starved dispatcher core is visible) and
     # any stall autopsies the watchdogs wrote
     rec["spans"] = spans_mod.snapshot()["stages"]
+    # device-plane observatory (ISSUE 14): the per-dispatch ledger's
+    # aggregates — dispatch rate, occupancy, effective verifies/s, pad
+    # waste, per-shape counts — as a first-class record block, the
+    # surface tools/bench_gate.py device floors and
+    # tools/verify_observatory.py gate on
+    rec["device"] = devledger_mod.snapshot()
     rec["loop_lag"] = loop_lag
     if watchdogs:
         rec["autopsy_dumps"] = sum(wd.dumps for wd in watchdogs)
@@ -833,6 +851,13 @@ async def main() -> None:
         "view-change validation is fast — on a single-core host a 64-node "
         "certificate takes seconds to check, so raise this accordingly",
     )
+    ap.add_argument(
+        "--device-profile", type=float, default=0.0,
+        help="arm ONE bounded jax.profiler capture of this many seconds "
+        "per cell (needs --flight-dir; artifacts under "
+        "<flight-dir>/device_profile). The always-on device ledger "
+        "(rec['device']) does not need this — kernel forensics only",
+    )
     args = ap.parse_args()
     # watchdog scales with the requested ladder: measurement time plus
     # generous per-config setup/teardown slack (large committees take tens
@@ -921,6 +946,7 @@ async def main() -> None:
             flight_dir=args.flight_dir,
             trace_sample=args.trace_sample,
             stall_deadline=args.stall_deadline,
+            device_profile=args.device_profile,
         )
         if args.storm:
             rec = await run_config(

@@ -34,7 +34,7 @@ from collections import OrderedDict
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
-from .. import sanitize, spans
+from .. import devledger, sanitize, spans
 from ..crypto import bls
 from ..messages import QuorumCert, qc_payload
 
@@ -437,6 +437,23 @@ class QcVerifyLane:
             return
         dt_ms = (time.perf_counter() - t0) * 1e3
         spans.record(spans.QC_PAIRING, dt_ms / 1e3, n=len(take))
+        # device-ledger event for the BLS pairing lane (ISSUE 14): same
+        # schema as the Ed25519 jit dispatches — one row per RLC batch,
+        # queue wait = mean lane wait, bytes_up = the certificate
+        # material the pairing consumed (payloads + aggregates + 96 B
+        # per signer pubkey). No jit here, so compile is always cached.
+        devledger.record(
+            devledger.LANE_BLS, "pairing", 0, len(take), len(take),
+            rtt_s=dt_ms / 1e3,
+            queue_wait_s=(
+                sum(t0 - e.t_enq for e in take) / len(take) if take else 0.0
+            ),
+            submissions=len(take),
+            bytes_up=sum(
+                len(e.payload) + len(e.agg) + 96 * len(e.pks) for e in take
+            ),
+            bytes_down=len(take),
+        )
         self.batches += 1
         self.batch_items += len(take)
         self.max_batch_seen = max(self.max_batch_seen, len(take))

@@ -275,6 +275,16 @@ class Replica:
         # the progress watchdog's stall age and pbft_top's CAGE column
         # read this instead of re-deriving progress from counter deltas
         self.last_commit_mono = 0.0
+        # heartbeat evidence: sender -> clock of the last message that
+        # survived the sweep (signature-verified when verification is
+        # on). The view-change dead-target fast-path reads this — a
+        # peer silent for multiples of the view timeout WHILE others
+        # are loud is evidence-dead, and failover skips views whose
+        # primary it names (the PR 10 search-found +369..+750 s tail:
+        # every live replica parked on a crashed primary's target view,
+        # retransmitting into silence up the 60 s backoff ladder).
+        self.peer_seen: Dict[str, float] = {}
+        self._boot_mono = 0.0
         # chunked checkpoint state-transfer driver (consensus/statesync.py):
         # both the requester side (watermark-gap / NEW-VIEW / cold-start
         # rejoin catch-up) and the server side (peers' chunk requests)
@@ -323,6 +333,10 @@ class Replica:
 
     def start(self) -> None:
         self._running = True
+        # silence is judged from boot, not from epoch 0: a peer we have
+        # never heard from is "silent since boot", so an idle committee
+        # (nobody heard from anybody) never looks dead
+        self._boot_mono = clock.now()
         loop = asyncio.get_running_loop()
         self._queue = asyncio.Queue(maxsize=1)
         self._stranded = []
@@ -712,6 +726,11 @@ class Replica:
                 # (QuorumCerts are audited post-pairing in _on_qc instead —
                 # an unverified aggregate must never become evidence)
                 self.auditor.observe_message(msg)
+            if msg.sender in self._replica_set:
+                # heartbeat evidence for the dead-target fast-path: any
+                # surviving message from a committee member proves it
+                # alive NOW (one dict store; read by ViewChanger)
+                self.peer_seen[msg.sender] = clock.now()
             await self._route(msg)
         await self._propose_if_ready()
         self.stats.sweep_ms.record((clock.now() - t0) * 1e3)

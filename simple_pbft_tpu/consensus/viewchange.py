@@ -388,6 +388,18 @@ class ViewChanger:
     # Byzantine memory-growth vector)
     MAX_VIEWS_AHEAD = 128
 
+    # Dead-target fast-path (ISSUE 14 satellite; the PR 10 search-found
+    # failover tail). A candidate view's primary is EVIDENCE-DEAD when
+    # it has been silent for this many view timeouts WHILE at least
+    # f other peers were heard inside the same window — the asymmetry
+    # (everyone else loud, this one mute) is what distinguishes a
+    # crashed peer from our own partition or an idle committee, so the
+    # fast-path can never fire when WE are the cut-off ones. Floor and
+    # cap keep the window sane at extreme timeout configs.
+    DEAD_SILENCE_FACTOR = 2.0
+    DEAD_SILENCE_FLOOR = 1.0
+    DEAD_SILENCE_CAP = 30.0
+
     def __init__(self, replica) -> None:
         self.r = replica
         self.in_view_change = False
@@ -594,6 +606,18 @@ class ViewChanger:
         self._deferred_key = None
         if self.in_view_change:
             self._target_expiries += 1
+            # Dead-target fast-path (ISSUE 14 satellite): our target
+            # view's primary is evidence-dead — silent for multiples of
+            # the timeout while the rest of the committee is loud. A
+            # dead primary will never assemble the NEW-VIEW, so
+            # retransmitting VIEW-CHANGEs at it is the measured
+            # +369..+750 s failover tail (PR 10's search-found repro,
+            # tests/sim_repros/slow_failover_tail.json): skip straight
+            # to escalation, and let next_live_target route past any
+            # further dead-primaried views.
+            dead_target = self.primary_evidence_dead(self.target_view)
+            if dead_target:
+                r.metrics["dead_target_fastpath"] += 1
             # "gathering": the target's certificate is visibly STILL
             # FILLING (>= f+1 support and more than at the last expiry).
             # A full-but-static store means the target's primary is dead
@@ -606,7 +630,9 @@ class ViewChanger:
                 and support > self._last_target_support
             )
             self._last_target_support = support
-            if self._target_expiries % 2 == 1 or gathering:
+            if not dead_target and (
+                self._target_expiries % 2 == 1 or gathering
+            ):
                 # RETRANSMIT for the SAME view instead of escalating:
                 # (a) on the first expiry at a target — the broadcast
                 # itself is lossy, and unilateral +1 laddering outruns
@@ -626,8 +652,62 @@ class ViewChanger:
                 return
         self._target_expiries = 0
         # retain the task: a bare ensure_future is only weakly referenced
-        # by the loop and can be collected mid-broadcast
-        self._spawn(self.start_view_change(max(self.target_view, r.view) + 1))
+        # by the loop and can be collected mid-broadcast. The target is
+        # the next view whose primary is not evidence-dead (see
+        # next_live_target) — the initial expiry and every escalation
+        # both route around crashed primaries.
+        self._spawn(self.start_view_change(
+            self.next_live_target(max(self.target_view, r.view) + 1)
+        ))
+
+    def _dead_window(self) -> float:
+        base = self.r.cfg.view_timeout
+        return min(
+            max(self.DEAD_SILENCE_FACTOR * base, self.DEAD_SILENCE_FLOOR),
+            self.DEAD_SILENCE_CAP,
+        )
+
+    def primary_evidence_dead(self, view: int) -> bool:
+        """Is `view`'s primary evidence-dead — silent past the window
+        while the committee is audibly alive? Conservative by design:
+        never true for ourselves, never true in an idle committee (no
+        peer is "recent" there, so the liveness quorum fails), never
+        true when we are the partitioned ones (same reason). A wrong
+        verdict costs one extra view of rotation, never safety — view
+        numbers are coordination, and any replica may join any higher
+        view."""
+        r = self.r
+        pid = r.cfg.primary(view)
+        if pid == r.id:
+            return False
+        now = clock.now()
+        window = self._dead_window()
+        boot = getattr(r, "_boot_mono", 0.0)
+        seen = getattr(r, "peer_seen", None)
+        if not seen:
+            return False
+        if now - seen.get(pid, boot) < window:
+            return False  # heard from it recently: alive
+        loud = sum(
+            1 for p in r.cfg.replica_ids
+            if p not in (r.id, pid) and now - seen.get(p, boot) < window
+        )
+        return loud >= max(1, r.cfg.weak_quorum - 1)
+
+    def next_live_target(self, start: int) -> int:
+        """First view at/after `start` whose primary is not evidence-
+        dead, skipping at most one committee rotation (n-1 views) so a
+        totally-dark evidence table can never stall escalation. Each
+        skip saves the full retransmit-then-escalate ladder rung —
+        +369..+750 s of measured tail in the PR 10 repro, where every
+        live replica camped on the crashed primary's target view."""
+        v = start
+        for _ in range(self.r.cfg.n - 1):
+            if not self.primary_evidence_dead(v):
+                return v
+            self.r.metrics["deadview_skipped"] += 1
+            v += 1
+        return v
 
     def _backlog_head(self):
         """Oldest outstanding client work, as a stable identity: relay

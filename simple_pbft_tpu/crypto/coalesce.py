@@ -39,7 +39,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import List, Optional, Sequence
 
-from .. import sanitize, spans
+from .. import devledger, sanitize, spans
 from .verifier import BatchItem, Verifier, best_cpu_verifier
 
 
@@ -333,6 +333,12 @@ class VerifyService:
         shape = getattr(self._device, "shape_snapshot", None)
         if callable(shape):
             out["device_shapes"] = shape()
+        # per-dispatch device ledger aggregates (ISSUE 14): dispatch
+        # rate, occupancy, effective verifies/s, pad waste, coalescing
+        # efficiency — the block telemetry/pbft_top/bench records and
+        # tools/verify_observatory.py consume. Process-wide, like the
+        # service itself.
+        out["device"] = devledger.snapshot()
         return out
 
     def close(self) -> None:
@@ -491,10 +497,22 @@ class VerifyService:
                 batch: List[BatchItem] = []
                 for items, _fut in subs:
                     batch.extend(items)
+                # hand the take's admission-queue wait to the device
+                # ledger: dispatch_batch runs synchronously on THIS
+                # thread, so the thread-local annotation reaches the
+                # per-dispatch event the verifier records (ISSUE 14)
+                if waits and total:
+                    devledger.annotate(
+                        sum(w * n for w, n in waits) / total, len(subs)
+                    )
                 t0 = time.perf_counter()
                 try:
                     finisher = self._device.dispatch_batch(batch)
                 except BaseException as e:  # noqa: BLE001
+                    # the annotation above was never consumed (the
+                    # dispatch died before recording): clear it, or the
+                    # NEXT take's event inherits this take's queue wait
+                    devledger.take_annotation()
                     self._fail(subs, e)
                     with self._cond:
                         self._inflight -= 1

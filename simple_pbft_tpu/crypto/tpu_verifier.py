@@ -731,16 +731,19 @@ class TpuVerifier:
         for b in buckets:
             self.verify_batch([dummy] * b)
 
-    def _record_shape(self, size: int) -> None:
+    def _record_shape(self, size: int) -> bool:
         """Track the jit signature this dispatch hits. Must run AFTER
         host prep (bank lookups can grow the table capacity, which is
         part of the signature) and records under the bank lock's
         protection being unnecessary: GIL-atomic set/dict ops, and the
-        counters are observability, not control flow."""
+        counters are observability, not control flow. Returns whether
+        the signature is FRESH (this dispatch traces and compiles) —
+        the device ledger's compile-vs-cache column."""
         cap = self._bank._cap if self._bank is not None else 0
         sig = (self._mode, self._window, size, cap)
         self.bucket_hits[size] = self.bucket_hits.get(size, 0) + 1
-        if sig not in self.shape_signatures:
+        fresh = sig not in self.shape_signatures
+        if fresh:
             self.shape_signatures.add(sig)
             self.shape_compiles += 1
             if self._warm_done:
@@ -752,6 +755,7 @@ class TpuVerifier:
                     "mid-run XLA compile (extend warm_for_population's "
                     "bucket set or initial_keys)", sig,
                 )
+        return fresh
 
     def shape_snapshot(self) -> dict:
         """Shape-stability counters for the telemetry plane: after
@@ -778,11 +782,19 @@ class TpuVerifier:
         dispatch + immediate finish."""
         if not items:
             return lambda: []
+        from .. import devledger
+
         finishers = []
         maxb = BUCKETS[-1]
+        # the dispatcher's queue-wait annotation covers the WHOLE take:
+        # consume it once here and attribute it to the first chunk —
+        # later chunks of an oversized take record (0, 0), so the lane's
+        # submission count matches the service's truth
+        annotation = devledger.take_annotation()
         for start in range(0, len(items), maxb):
             chunk = items[start : start + maxb]
-            finishers.append(self._dispatch_chunk(chunk))
+            finishers.append(self._dispatch_chunk(chunk, annotation))
+            annotation = (0.0, 0)
 
         def finish() -> List[bool]:
             out: List[bool] = []
@@ -792,7 +804,11 @@ class TpuVerifier:
 
         return finish
 
-    def _dispatch_chunk(self, items: Sequence[BatchItem]):
+    def _dispatch_chunk(
+        self,
+        items: Sequence[BatchItem],
+        annotation: "tuple[float, int]" = (0.0, 1),
+    ):
         t_prep = time.perf_counter()
         size = _bucket_size(max(len(items), self._align))
         fallback: List[int] = []
@@ -813,18 +829,25 @@ class TpuVerifier:
         else:
             prep = prepare_batch(items).padded(size)
             args = prep.arrays()
-        self._record_shape(size)
+        compile_fresh = self._record_shape(size)
         # host-side prep (nibble decomposition, padding, array builds)
         # is CPU work on the dispatcher's thread — if it rivals the
         # device RTT the pipeline is host-bound, and only a span can say
         # so (spans.py; the r5 "where do the other 96% go" question)
-        from .. import spans
+        from .. import devledger, spans
 
-        spans.record(
-            spans.VERIFY_HOST_PREP,
-            time.perf_counter() - t_prep,
-            n=len(items),
+        prep_s = time.perf_counter() - t_prep
+        spans.record(spans.VERIFY_HOST_PREP, prep_s, n=len(items))
+        # host->device upload: the freshly-built host arrays (persistent
+        # device tables are excluded — they upload once per bank change,
+        # not per dispatch); the verdict bitmap comes back one byte/row
+        bytes_up = sum(
+            a.nbytes for a in args if isinstance(a, np.ndarray)
         )
+        # queue-wait annotation consumed once per take by dispatch_batch
+        # (the coalescing dispatcher sets it on this thread; direct
+        # callers default to zero wait / one submission)
+        queue_wait_s, submissions = annotation
         with _DEVICE_LOCK:
             t0 = time.perf_counter()
             dev_out = self._fn(*args)  # async: enqueue only
@@ -834,13 +857,24 @@ class TpuVerifier:
         def finish() -> List[bool]:
             # np.array (copy): fallback rows below are written in place
             verdict = np.array(dev_out)  # blocks until the device answers
+            rtt = time.perf_counter() - t0
             # dispatch->result wall time. Overlapped calls each count
             # their full span, so the sum can exceed wall clock under
             # pipelining — device_seconds is a latency integral, not an
             # occupancy figure (verify_per_s_device derived from it is a
             # LOWER bound on the device rate when calls overlap).
             with _DEVICE_LOCK:
-                self.device_seconds += time.perf_counter() - t0
+                self.device_seconds += rtt
+            # per-dispatch device ledger event (ISSUE 14): one row per
+            # jit dispatch with the full cost tuple — the continuously-
+            # measured form of the r05 hand decomposition
+            devledger.record(
+                devledger.LANE_ED25519, self._mode, self._window, size,
+                len(items), host_prep_s=prep_s, rtt_s=rtt,
+                compile_fresh=compile_fresh, bytes_up=bytes_up,
+                bytes_down=size, queue_wait_s=queue_wait_s,
+                submissions=submissions,
+            )
             if fallback:
                 if self._cpu_fb is None:
                     from .verifier import kernel_equivalent_cpu_verifier

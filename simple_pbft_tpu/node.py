@@ -194,6 +194,22 @@ async def run_node(args) -> None:
         args.id,
         os.path.join(log_dir, f"{args.id}.spans.jsonl") if log_dir else None,
     )
+    # device-plane observatory (ISSUE 14): reset the per-dispatch device
+    # ledger HERE — after the verifier warm, so warmup compiles never
+    # pollute the serving window's occupancy/rate aggregates, and in
+    # lockstep with spans so tools/verify_observatory.py can reconcile
+    # the two surfaces over the same window
+    from . import devledger
+
+    devledger.configure(args.id)
+    if getattr(args, "device_profile", 0) > 0 and log_dir:
+        # optional deep capture: ONE bounded jax.profiler trace window,
+        # armed off-loop on a sidecar thread (never in consensus paths);
+        # artifacts land under <log-dir>/device_profile for offline
+        # analysis next to the flight timeline
+        devledger.arm_profile(
+            os.path.join(log_dir, "device_profile"), args.device_profile
+        )
     tracer = None
     sample_mod = resolve_sample_mod(args.trace_sample)
     if sample_mod > 0 and log_dir:
@@ -379,6 +395,15 @@ def main() -> None:
         "<log-dir>/<id>.evidence.jsonl and per-slot observations to "
         "<id>.audit.jsonl (joined across nodes by "
         "tools/ledger_audit.py); 0 disables (docs/AUDIT.md)",
+    )
+    ap.add_argument(
+        "--device-profile", type=float, default=0,
+        help="device-plane deep capture: arm ONE bounded jax.profiler "
+        "trace of this many seconds right after boot (off-loop, never "
+        "in consensus paths); artifacts land under "
+        "<log-dir>/device_profile. 0 = off. The always-on per-dispatch "
+        "device ledger (docs/OBSERVABILITY.md §device observatory) "
+        "does not need this — the flag is for kernel-level forensics",
     )
     ap.add_argument(
         "--stall-deadline", type=float, default=30.0,

@@ -26,10 +26,13 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import time
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 try:  # jax >= 0.8
@@ -37,8 +40,66 @@ try:  # jax >= 0.8
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
+from .. import devledger
 from ..crypto.tpu_verifier import verify_kernel
 from ..ops import comb
+
+
+def instrument_step(step, mesh: Mesh, mode: str = "ladder",
+                    window: int = 4):
+    """Wrap a jitted SPMD quorum step so every invocation lands in the
+    device ledger as PER-DEVICE shard events (ISSUE 14): the 8-mesh
+    shard-out inherits the exact schema the single-chip verify path
+    records, day one — mode, bucket (the per-shard batch), pad, RTT,
+    compile-vs-cache, host->device bytes.
+
+    The wrapper BLOCKS on the result (``block_until_ready``) so the
+    recorded RTT is dispatch->answer, like ``TpuVerifier``'s — callers
+    that want async overlap should dispatch the raw step and record
+    manually. ``n_valid`` is the pre-padding item count (pad waste);
+    defaults to the full batch. Recording is per device because SPMD
+    runs every chip for the whole pass: occupancy aggregates correctly
+    only when busy seconds are attributed per device.
+    """
+    ndev = int(np.prod(mesh.devices.shape))
+    seen_shapes: set = set()
+
+    def run(*args, n_valid: Optional[int] = None):
+        batch = next(
+            (int(a.shape[-1]) for a in args
+             if hasattr(a, "shape") and len(a.shape) == 1),
+            0,
+        )
+        if batch == 0:  # no 1-D batch arg: run unrecorded, never raise
+            return step(*args)
+        bytes_up = sum(
+            a.nbytes for a in args if isinstance(a, np.ndarray)
+        )
+        sig = (mode, window, batch)
+        fresh = sig not in seen_shapes
+        seen_shapes.add(sig)
+        t0 = time.perf_counter()
+        out = step(*args)
+        out = jax.block_until_ready(out)
+        rtt = time.perf_counter() - t0
+        valid = batch if n_valid is None else int(n_valid)
+        per = batch // ndev
+        per_valid = valid // ndev
+        rem = valid - per_valid * ndev
+        for d in range(ndev):
+            devledger.record(
+                devledger.LANE_SHARD, mode, window, per,
+                per_valid + (1 if d < rem else 0),
+                # one SPMD trace = ONE XLA compile, not ndev: stamp it
+                # on the first device row only so the lane's compile
+                # counter matches reality
+                rtt_s=rtt, compile_fresh=fresh and d == 0,
+                bytes_up=bytes_up // ndev, bytes_down=per,
+                device=f"d{d}",
+            )
+        return out
+
+    return run
 
 
 def make_comb_quorum_step(mesh: Mesh, axis: str = "dp"):

@@ -1,0 +1,14 @@
+# pbftlint: shape-tracked-module
+"""PBL006 negative twin of shape_devledger_pos: the full ISSUE 14
+dispatch-recording seam — shape recording AND the device-ledger event
+in the same body — is exactly what crypto/tpu_verifier.py does."""
+
+from simple_pbft_tpu import devledger
+
+
+class Verifier:
+    def dispatch(self, batch):
+        self._record_shape(len(batch))
+        out = self._fn(batch)
+        devledger.record("ed25519", "fused", 4, len(batch), len(batch))
+        return out

@@ -1,0 +1,14 @@
+# pbftlint: shape-tracked-module
+"""PBL006 positive (ISSUE 14 seam): a device-ledger record in the same
+body must NOT launder the missing _record_shape — the ledger counts the
+dispatch's cost, the shape recorder keeps post_warm_compiles honest,
+and only the latter satisfies the check."""
+
+from simple_pbft_tpu import devledger
+
+
+class Verifier:
+    def dispatch(self, batch):
+        out = self._fn(batch)  # no _record_shape: must flag
+        devledger.record("ed25519", "fused", 4, len(batch), len(batch))
+        return out

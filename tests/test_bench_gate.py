@@ -136,6 +136,38 @@ class TestGate:
         rep = bench_gate.run_gate(fresh, canary)
         assert not rep["ok"]
 
+    def test_device_floors_and_relative_directions(self):
+        """ISSUE 14: device-ledger aggregates gate both ways — absolute
+        floors (the device-smoke CI shape, including the checked-in
+        reference file) and relative directions (occupancy/items-per-
+        dispatch only regress DOWN, pad waste only UP)."""
+        dev = {"dispatches": 20, "occupancy": 0.9,
+               "items_per_dispatch": 12.0, "pad_waste_pct": 20.0,
+               "verifies_per_s_effective": 5000.0}
+        # the committed CI reference accepts a healthy device cell
+        ref_path = os.path.join(
+            ROOT, "bench_results", "device_ci_reference.jsonl")
+        ref = [json.loads(l) for l in open(ref_path)]
+        fresh = [mkline("device-smoke-cpu", device=dev)]
+        assert bench_gate.run_gate(fresh, ref)["ok"]
+        # an impossible occupancy floor flags (the CI canary shape)
+        canary = json.loads(json.dumps(ref))
+        canary[0]["gate"]["min"]["device.occupancy"] = 2.0
+        rep = bench_gate.run_gate(fresh, canary)
+        assert not rep["ok"]
+        assert any(r["metric"] == "device.occupancy"
+                   for r in rep["regressions"])
+        # relative mode: coalescing regression (items/dispatch halved)
+        # and pad-waste blowup flag; an occupancy IMPROVEMENT does not
+        ref_rel = [mkline("dev", device=dev)]
+        worse = dict(dev, items_per_dispatch=4.0, pad_waste_pct=60.0,
+                     occupancy=0.99)
+        rep2 = bench_gate.run_gate([mkline("dev", device=worse)], ref_rel)
+        flagged = {r["metric"] for r in rep2["regressions"]}
+        assert "device.items_per_dispatch" in flagged
+        assert "device.pad_waste_pct" in flagged
+        assert "device.occupancy" not in flagged
+
     def test_cli_exit_codes_and_json(self, tmp_path):
         ref_p, fresh_p = tmp_path / "ref.jsonl", tmp_path / "fresh.jsonl"
         ref_p.write_text(

@@ -161,6 +161,18 @@ def test_shape_nested_record_does_not_satisfy_outer():
     assert res["findings"][0].scope == "Verifier.outer"
 
 
+def test_shape_devledger_record_does_not_launder_dispatch():
+    """ISSUE 14 dispatch-recording seam: a devledger.record() in the
+    dispatch body counts the pass's COST but does not keep
+    post_warm_compiles honest — only _record_shape does, so the
+    ledger-only positive must still flag and the full seam (both calls,
+    the tpu_verifier shape) must pass clean."""
+    res = run("shape_devledger_pos.py")
+    assert codes(res) == ["PBL006"]
+    assert res["findings"][0].detail == "unrecorded-dispatch:self._fn"
+    assert codes(run("shape_devledger_neg.py")) == []
+
+
 # ---------------------------------------------------------------------------
 # baseline + suppression plumbing
 # ---------------------------------------------------------------------------

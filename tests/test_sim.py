@@ -247,10 +247,21 @@ def test_schedule_summary_replay_tuple():
 # ---------------------------------------------------------------------------
 
 
-def test_minimizer_shrinks_known_bad_schedule():
+def test_minimizer_shrinks_known_bad_schedule(monkeypatch):
     """Start from the checked-in slow-failover repro (2 essential
     events at a tightened patience) buried under noise events; ddmin
-    must strip the noise back down while preserving the failure."""
+    must strip the noise back down while preserving the failure.
+
+    The ISSUE 14 dead-target fast-path FIXED the tail this repro
+    records (see test_slow_failover_tail_repro_fast_failover), so to
+    keep a known-bad schedule for the minimizer to converge on, the
+    fast-path is disabled here — this test exercises ddmin, not the
+    failover ladder."""
+    from simple_pbft_tpu.consensus.viewchange import ViewChanger
+
+    monkeypatch.setattr(
+        ViewChanger, "primary_evidence_dead", lambda self, view: False
+    )
     doc = load_repro("slow_failover_tail.json")
     base = scenario_from_artifact(doc)
     # tighten the oracle so the KNOWN tail counts as the failure under
@@ -280,21 +291,26 @@ def test_minimizer_shrinks_known_bad_schedule():
 # ---------------------------------------------------------------------------
 
 
-def test_slow_failover_tail_repro_converges_but_slowly():
+def test_slow_failover_tail_repro_fast_failover():
     """The coverage-guided search found (and ddmin minimized) a
-    crash+partition interleaving that parks every live replica on a
+    crash+partition interleaving that parked every live replica on a
     crashed primary's target view for MINUTES of virtual time (the
-    backoff ladder retransmits-then-escalates at 60 s rungs). It
-    converges — so the wedge oracle, calibrated at 600 s, passes it —
-    but the recovery-latency coverage signal must keep seeing it, and
-    this replay pins the tail so a future ladder fix shows up as this
-    assertion flipping to 'fast'. Triage: docs/SCENARIOS.md."""
+    backoff ladder retransmitted-then-escalated at 60 s rungs; probe_s
+    was 300+ when the repro was checked in). The ISSUE 14 dead-target
+    fast-path fixes it: heartbeat silence marks the crashed primary
+    evidence-dead, escalation skips its views, and the same schedule
+    now converges promptly. This replay is the regression gate — the
+    ladder reappearing flips probe_s back over the bound. Triage:
+    docs/SCENARIOS.md."""
     sc = scenario_from_artifact(load_repro("slow_failover_tail.json"))
-    # the artifact records the patience the search ran at (300 s, still
-    # inside the tail); judge convergence at the calibrated wedge bound
+    # the artifact records the patience the search ran at (300 s, once
+    # inside the tail); judge at the calibrated wedge bound
     res = run_scenario(replace(sc, probe_patience=600.0))
     assert res.ok, res.failure  # converges within the wedge oracle
-    assert res.coverage["probe_s"] > 90  # ...but pathologically slowly
+    # the fixed ladder recovers fast: probe_s bounded well under the
+    # pre-fix 300+ s tail (measured 0 s with the fast-path; 90 is the
+    # old test's "pathologically slow" threshold, now the ceiling)
+    assert res.coverage["probe_s"] <= 90, res.coverage
 
 
 def test_planted_defect_wedge_repro():

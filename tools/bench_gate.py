@@ -69,6 +69,16 @@ METRICS: Dict[str, Tuple[int, float]] = {
     "wire.per_commit.total_msgs_per_req": (-1, 0.25),
     "wire.per_commit.total_bytes_per_req": (-1, 0.30),
     "reconfig.spike_width_s": (-1, 0.60),
+    # device-plane observatory aggregates (ISSUE 14): coalescing
+    # regressions show as items/dispatch dropping (more, smaller device
+    # passes for the same load), warm-set leaks as pad waste rising;
+    # occupancy and effective verify rate are wall-clock-noisy on shared
+    # hosts, hence the wide floors — CI uses gate.min floors instead
+    # (bench_results/device_ci_reference.jsonl).
+    "device.items_per_dispatch": (+1, 0.40),
+    "device.verifies_per_s_effective": (+1, 0.40),
+    "device.occupancy": (+1, 0.50),
+    "device.pad_waste_pct": (-1, 0.50),
 }
 
 MAD_Z = 4.0  # tolerance = MAD_Z sigma-equivalents of the reference spread

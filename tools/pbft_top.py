@@ -36,7 +36,7 @@ from typing import Dict, List, Optional, Tuple
 COLUMNS = (
     "NODE", "SRC", "VIEW", "ROLE", "EXEC", "STABLE", "CAGE", "BACKLOG",
     "VQ", "QCQ", "QCB", "PAIRms", "SHED", "DEG", "QUAR", "REJ", "WDOG",
-    "AUD", "NET", "NETIO", "RTTms", "LAGms", "REQ/s",
+    "AUD", "NET", "NETIO", "DEV", "RTTms", "LAGms", "REQ/s",
 )
 
 
@@ -62,6 +62,28 @@ def netio_cell(snap: dict, prev: Optional[dict], dt: float) -> str:
         if dm >= 0 and db >= 0:
             return f"{dm / dt:.0f}/s {_fmt_kib(db / dt)}/s"
     return f"{msgs} {_fmt_kib(byts)}"
+
+
+def _fmt_rate(v: float) -> str:
+    return f"{v / 1000:.1f}k" if v >= 1000 else f"{v:.0f}"
+
+
+def dev_cell(snap: dict) -> str:
+    """DEV: device-plane observatory aggregates (ISSUE 14) —
+    ``disp/s occ% eff-verifies/s pad%`` from the verify service's
+    ``device`` ledger block. Works identically from a live scrape and
+    from a flight-file tail (the block rides every frame), so a wedged
+    node's last device posture is still one glance. Blank when the node
+    never dispatched to a device (CPU-verifier committees)."""
+    dev = (snap.get("verify") or {}).get("device") or {}
+    if not dev.get("dispatches"):
+        return ""
+    return (
+        f"{dev.get('dispatches_per_s', 0):.1f}/s "
+        f"{dev.get('occupancy', 0) * 100:.0f}% "
+        f"{_fmt_rate(dev.get('verifies_per_s_effective', 0))}v/s "
+        f"{dev.get('pad_waste_pct', 0):.0f}%"
+    )
 
 
 def net_cell(snap: dict) -> str:
@@ -238,6 +260,7 @@ def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
         aud_cell,
         net_cell(snap),
         netio_cell(snap, prev, dt),
+        dev_cell(snap),
         (f"{ver['rtt_ms_ema']:.0f}" if "rtt_ms_ema" in ver else ""),
         (f"{lag['ema_ms']:.1f}" if "ema_ms" in lag else ""),
         rate,

@@ -46,6 +46,7 @@ TELEMETRY_ROOTS = {
     "flight",
     "watchdog",
     "recorder",
+    "devledger",
 }
 
 # (root, terminal attr) -> (owning module path, class or None, def name)
@@ -77,6 +78,18 @@ AUDITED_NO_RAISE: Dict[Tuple[str, str], Tuple[str, Optional[str], str]] = {
         "simple_pbft_tpu/audit.py", "SafetyAuditor", "on_epoch"),
     ("auditor", "gc"): ("simple_pbft_tpu/audit.py", "SafetyAuditor", "gc"),
     ("stats", "record"): ("simple_pbft_tpu/logutil.py", "Histogram", "record"),
+    # device-plane event ledger (ISSUE 14): the dispatch-recording seam
+    # in consensus/qc.py (and any future consensus-side device lane)
+    # rides these module-level never-raise entries — record() broad-
+    # guards its own body, annotate()/take_annotation() guard the
+    # thread-local handoff
+    ("devledger", "record"): ("simple_pbft_tpu/devledger.py", None, "record"),
+    ("devledger", "annotate"): (
+        "simple_pbft_tpu/devledger.py", None, "annotate"),
+    ("devledger", "take_annotation"): (
+        "simple_pbft_tpu/devledger.py", None, "take_annotation"),
+    ("devledger", "snapshot"): (
+        "simple_pbft_tpu/devledger.py", None, "snapshot"),
 }
 
 
