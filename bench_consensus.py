@@ -174,6 +174,7 @@ async def run_config(
     trace_sample: float = 0,
     stall_deadline: float = 30.0,
     device_profile: float = 0.0,
+    speculative: bool = True,
 ) -> dict:
     from simple_pbft_tpu.committee import LocalCommittee
     from simple_pbft_tpu.crypto.coalesce import VerifyService
@@ -293,6 +294,9 @@ async def run_config(
         checkpoint_interval=64,
         watermark_window=1024,
         qc_mode=qc_mode,
+        # ISSUE 15: speculative execution at PREPARED (on by default;
+        # --no-spec is the A/B arm measuring the pre-speculation shape)
+        speculative=speculative,
     )
     for c in com.clients:
         # Storms/chaos: the first send of a request can go to a crashed
@@ -638,8 +642,13 @@ async def run_config(
 
     lat_ms = sorted(x * 1e3 for _, x in latencies)
 
+    def _pctv(vals, p: float) -> float:
+        # one percentile formula for every latency surface in the record
+        # (p50_ms, the spec/final split): nearest-rank on a sorted list
+        return vals[min(len(vals) - 1, int(p * len(vals)))] if vals else 0.0
+
     def pct(p: float) -> float:
-        return lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))] if lat_ms else 0.0
+        return _pctv(lat_ms, p)
 
     from simple_pbft_tpu.telemetry import (
         BENCH_SCHEMA_VERSION,
@@ -656,6 +665,7 @@ async def run_config(
         "config": name,
         "n": n,
         "qc_mode": qc_mode,
+        "speculative": speculative,
         "chaos": chaos or None,
         "verifier": verifier,
         "clients": n_clients,
@@ -687,6 +697,56 @@ async def run_config(
     rec.update(shed_info)
     rec.update(verify_stats)
     rec.update(crash_info)
+    # speculative execution (ISSUE 15): the p50/p99 split the roadmap
+    # acceptance gates on — spec-accept latency (client submit -> 2f+1
+    # matching speculative marks) vs final-commit confirmation latency
+    # (submit -> f+1 final replies) — plus the replica-side slot
+    # counters and the execute.spec/execute.final span histograms that
+    # attribute the win per percentile (already in rec["spans"])
+    spec_lat = sorted(
+        lat * 1e3
+        for c in com.clients
+        for (lat, kind) in getattr(c, "accept_latencies", ())
+        if kind == "spec"
+    )
+    confirm_lat = sorted(
+        lat * 1e3
+        for c in com.clients
+        for lat in getattr(c, "confirm_latencies", ())
+    )
+
+    rec["spec"] = {
+        "executed": sum(
+            r.metrics.get("spec_executed", 0) for r in com.replicas
+        ),
+        "confirmed": sum(
+            r.metrics.get("spec_confirmed", 0) for r in com.replicas
+        ),
+        "rolled_back": sum(
+            r.metrics.get("spec_rolled_back", 0) for r in com.replicas
+        ),
+        "rollbacks": sum(
+            r.metrics.get("spec_rollbacks", 0) for r in com.replicas
+        ),
+        "replies_sent": sum(
+            r.metrics.get("spec_replies_sent", 0) for r in com.replicas
+        ),
+        "client_spec_accepted": sum(
+            c.metrics.get("spec_accepted", 0) for c in com.clients
+        ),
+        "client_final_confirms": sum(
+            c.metrics.get("final_confirms", 0) for c in com.clients
+        ),
+        "client_spec_final_mismatch": sum(
+            c.metrics.get("spec_final_mismatch", 0) for c in com.clients
+        ),
+    }
+    if spec_lat:
+        rec["p50_spec_latency_ms"] = round(_pctv(spec_lat, 0.50), 2)
+        rec["p99_spec_latency_ms"] = round(_pctv(spec_lat, 0.99), 2)
+    if confirm_lat:
+        rec["p50_final_latency_ms"] = round(_pctv(confirm_lat, 0.50), 2)
+        rec["p99_final_latency_ms"] = round(_pctv(confirm_lat, 0.99), 2)
     # wire accounting (ISSUE 12 tentpole): the measurement window's
     # per-kind msgs+bytes and the derived per-commit costs — msgs/commit,
     # bytes/commit, per-phase broadcast amplification (the O(n²) storm,
@@ -852,6 +912,12 @@ async def main() -> None:
         "certificate takes seconds to check, so raise this accordingly",
     )
     ap.add_argument(
+        "--no-spec", action="store_true",
+        help="disable speculative execution (ISSUE 15) — the A/B arm "
+        "for attributing the spec-latency win; the record then carries "
+        "no p50_spec_latency_ms field",
+    )
+    ap.add_argument(
         "--device-profile", type=float, default=0.0,
         help="arm ONE bounded jax.profiler capture of this many seconds "
         "per cell (needs --flight-dir; artifacts under "
@@ -947,6 +1013,7 @@ async def main() -> None:
             trace_sample=args.trace_sample,
             stall_deadline=args.stall_deadline,
             device_profile=args.device_profile,
+            speculative=not args.no_spec,
         )
         if args.storm:
             rec = await run_config(

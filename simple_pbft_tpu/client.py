@@ -16,8 +16,8 @@ from __future__ import annotations
 import asyncio
 import itertools
 import random
-from collections import defaultdict
-from typing import Dict, Optional
+from collections import OrderedDict, defaultdict, deque
+from typing import Dict, Optional, Tuple
 
 from . import clock, spans
 from .config import CommitteeConfig, config_from_doc
@@ -99,8 +99,28 @@ class Client:
         # slewing (not stepping) time sync, or persist the last timestamp.
         self._ts = itertools.count(clock.timestamp_us())
         self._waiters: Dict[int, asyncio.Future] = {}
-        # per-ts replies: sender -> (result, superseded) — matched as a pair
+        # per-ts replies: sender -> (result, superseded, spec). One slot
+        # per replica (ISSUE 15 reply accounting): a replica upgrading
+        # its speculative reply to final overwrites its own slot — never
+        # a second count toward either quorum — and the stricter (final)
+        # mark wins: a late speculative reply never downgrades a
+        # recorded final one.
         self._replies: Dict[int, Dict[str, tuple]] = defaultdict(dict)
+        # how each accepted ts resolved ("spec" fast path or "final"),
+        # consumed by submit() for the latency split benches record
+        self._accept_kind: Dict[int, str] = {}
+        self._submit_t0: Dict[int, float] = {}
+        # speculative answers awaiting final-commit confirmation:
+        # ts -> {result, t0, senders}. The fast answer already resolved
+        # the submit; f+1 matching FINAL replies upgrade it to confirmed
+        # (metrics final_confirms + the confirm-latency sample). Bounded.
+        self._confirming: "OrderedDict[int, dict]" = OrderedDict()
+        self.CONFIRMING_MAX = 8192
+        # (latency_s, "spec"|"final") per accepted request, and the
+        # submit->f+1-final confirmation latencies — the bench ledger's
+        # p50_spec_latency_ms / p50_final_latency_ms sources
+        self.accept_latencies: deque = deque(maxlen=1 << 16)
+        self.confirm_latencies: deque = deque(maxlen=1 << 16)
         # wire bytes of in-flight requests, for the mixed-split early
         # rebroadcast below (submit() owns the normal retransmission)
         self._inflight_raw: Dict[int, bytes] = {}
@@ -154,11 +174,15 @@ class Client:
             if msg.sender not in self.cfg.replica_ids:
                 continue  # only replicas may answer; f+1 matching assumes it
             fut = self._waiters.get(msg.timestamp)
-            if fut is None or fut.done():
+            confirming = msg.timestamp in self._confirming
+            if (fut is None or fut.done()) and not confirming:
                 # nobody is waiting on this timestamp (late replies after
                 # f+1 matched, or stale retransmissions): skip the
                 # signature check — at committee size n the client
-                # otherwise pays n-(f+1) wasted verifies per request
+                # otherwise pays n-(f+1) wasted verifies per request.
+                # (A speculatively-accepted ts awaiting final-commit
+                # confirmation still verifies: the f+1 final quorum the
+                # confirmation trusts must be signature-checked.)
                 continue
             if self.cfg.verify_signatures:
                 if msg.mac:
@@ -188,7 +212,10 @@ class Client:
                     )
                     if not ok[0]:
                         continue
-            self._on_reply(msg)
+            if fut is None or fut.done():
+                self._on_confirm(msg)
+            else:
+                self._on_reply(msg)
 
     def _on_reply(self, msg: Reply) -> None:
         ts = msg.timestamp
@@ -208,16 +235,45 @@ class Client:
         # outcome — matching on (result, view) would deadlock exactly
         # when a view change lands mid-request. The view rides along
         # purely as the primary hint above.
-        self._replies[ts][msg.sender] = (msg.result, bool(msg.superseded))
-        counts: Dict[tuple, int] = defaultdict(int)
-        for val in self._replies[ts].values():
-            counts[val] += 1
-        for (result, superseded), cnt in counts.items():
+        spec = bool(getattr(msg, "spec", 0))
+        prev = self._replies[ts].get(msg.sender)
+        if prev is not None and not prev[2] and spec:
+            # reply accounting (ISSUE 15): this replica already answered
+            # FINAL — a late speculative copy must neither double-count
+            # nor downgrade the recorded mark
+            return
+        self._replies[ts][msg.sender] = (
+            msg.result, bool(msg.superseded), spec, msg.seq, msg.view,
+        )
+        counts_final: Dict[tuple, int] = defaultdict(int)
+        counts_slot: Dict[tuple, int] = defaultdict(int)
+        for result, superseded, sp, seq, view in self._replies[ts].values():
+            counts_slot[(result, superseded, seq, view)] += 1
+            if not sp:
+                counts_final[(result, superseded)] += 1
+        # final answer: f+1 matching non-speculative replies (classic —
+        # matching ignores seq/view: honest replicas execute the same
+        # request at the same agreed slot, and the result alone is what
+        # f+1 vouches for)
+        for key, cnt in counts_final.items():
             if cnt >= self.cfg.weak_quorum:
-                if superseded:
-                    fut.set_exception(SupersededError())
-                else:
-                    fut.set_result(result)
+                self._resolve(ts, fut, key, "final")
+                return
+        # speculative fast answer: 2f+1 matching marks of ANY strength
+        # (a final reply subsumes a speculative one from the same
+        # replica) — matched on (result, superseded, SEQ, VIEW). The
+        # full slot identity is part of the key because the safety
+        # argument is per prepare-certificate: 2f+1 speculators of one
+        # (view, seq) are 2f+1 preparers of ONE digest there (two
+        # conflicting 2f+1 prepare quorums at the same (view, seq) need
+        # > f double-voters), and by quorum intersection no later view
+        # can install a different block at that seq. Marks for the same
+        # request speculated at different seqs — or at the same seq
+        # under different views' re-proposals, each with <= f honest
+        # preparers — must never pool into a fake quorum.
+        for (result, superseded, _seq, _view), cnt in counts_slot.items():
+            if cnt >= self.cfg.quorum:
+                self._resolve(ts, fut, (result, superseded), "spec")
                 return
         # Mixed superseded/real split with no quorum: a checkpoint fold
         # raced our retransmission — replicas that folded answer
@@ -228,7 +284,7 @@ class Client:
         # but the answer does: nudge with one early rebroadcast (folded
         # replicas re-answer superseded from durable state) instead of
         # sitting out the full request_timeout.
-        flags = {s for _, s in self._replies[ts].values()}
+        flags = {s for _, s, _sp, _seq, _v in self._replies[ts].values()}
         if len(flags) == 2 and ts not in self._mixed_retry_done:
             self._mixed_retry_done.add(ts)
             raw = self._inflight_raw.get(ts)
@@ -236,6 +292,58 @@ class Client:
                 loop = asyncio.get_running_loop()
                 backoff = min(0.25, self.request_timeout / 4)
                 loop.call_later(backoff, self._fire_mixed_retry, ts, raw)
+
+    def _resolve(self, ts: int, fut: asyncio.Future, key: Tuple[str, bool],
+                 kind: str) -> None:
+        """A quorum formed for ``key`` = (result, superseded): answer the
+        waiter. A speculative acceptance additionally keeps collecting
+        FINAL replies for the same ts (the final-commit confirmation the
+        fast path must retain — satellite/PoE contract)."""
+        result, superseded = key
+        self._accept_kind[ts] = kind
+        if kind == "spec" and not superseded:
+            self.metrics["spec_accepted"] += 1
+            senders = {
+                s
+                for s, (res, sup, sp, _seq, _v) in self._replies[ts].items()
+                if not sp and (res, sup) == key
+            }
+            while len(self._confirming) >= self.CONFIRMING_MAX:
+                self._confirming.popitem(last=False)
+            self._confirming[ts] = {
+                "result": result,
+                "t0": self._submit_t0.get(ts, clock.now()),
+                "senders": senders,
+                "contradicting": set(),
+            }
+        if superseded:
+            fut.set_exception(SupersededError())
+        else:
+            fut.set_result(result)
+
+    def _on_confirm(self, msg: Reply) -> None:
+        """A signature-verified reply for a speculatively-accepted ts:
+        count FINAL copies toward the f+1 confirmation quorum."""
+        ent = self._confirming.get(msg.timestamp)
+        if ent is None or getattr(msg, "spec", 0) or msg.superseded:
+            return
+        if msg.result != ent["result"]:
+            # A single contradicting final can be one byzantine replica
+            # (well within f) — it must neither fire the alarm nor
+            # destroy confirmation tracking. Only f+1 DISTINCT
+            # contradictors prove the COMMITTEE contradicted the 2f+1
+            # speculative quorum — impossible under quorum intersection
+            # unless > f replicas are faulty; surface THAT loudly.
+            ent["contradicting"].add(msg.sender)
+            if len(ent["contradicting"]) >= self.cfg.weak_quorum:
+                self.metrics["spec_final_mismatch"] += 1
+                del self._confirming[msg.timestamp]
+            return
+        ent["senders"].add(msg.sender)
+        if len(ent["senders"]) >= self.cfg.weak_quorum:
+            self.metrics["final_confirms"] += 1
+            self.confirm_latencies.append(clock.now() - ent["t0"])
+            del self._confirming[msg.timestamp]
 
     def _bg(self, coro) -> None:
         """Launch a fire-and-forget send: hold the task reference (GC can
@@ -401,6 +509,7 @@ class Client:
         if traced:
             tracer.emit("submit", rid, op_bytes=len(operation))
         t_sub = clock.now()
+        self._submit_t0[ts] = t_sub  # confirmation latency anchors here
         try:
             # first attempt: primary (+ hedged backups); afterwards:
             # broadcast (classic PBFT retransmission — backups forward to
@@ -423,6 +532,10 @@ class Client:
                     )
                     if attempt:
                         self.metrics["recovered_after_retry"] += 1
+                    kind = self._accept_kind.pop(ts, "final")
+                    self.accept_latencies.append(
+                        (clock.now() - t_sub, kind)
+                    )
                     if traced:
                         tracer.emit("accepted", rid, attempts=attempt + 1)
                     # submit -> f+1 accepted: the client's view of the
@@ -451,3 +564,5 @@ class Client:
             self._replies.pop(ts, None)
             self._inflight_raw.pop(ts, None)
             self._mixed_retry_done.discard(ts)
+            self._accept_kind.pop(ts, None)
+            self._submit_t0.pop(ts, None)

@@ -34,6 +34,14 @@ class CommitteeConfig:
     # 2f+1 embedded votes.
     qc_mode: bool = False
     bls_pubkeys: Dict[str, bytes] = field(default_factory=dict)  # 192-byte G2
+    # Speculative pipelined execution (ISSUE 15): execute blocks at
+    # PREPARED against a forkable app state and reply early with a
+    # signed speculative mark; roll back any speculated suffix whose
+    # digest loses on view change (consensus/speculation.py). Commit
+    # latency is pipeline depth, not crypto (ROADMAP: ~400 ms p50 at
+    # n=16/depth=512 vs a 69 ms n=4 line), and speculation collapses
+    # the client-visible half of it — on by default, disable to A/B.
+    speculative: bool = True
     # X25519 key-exchange pubkeys (replicas AND clients) for the MAC'd
     # reply fast path (crypto/mac.py); pairs lacking either key fall
     # back to Ed25519-signed replies
@@ -86,6 +94,15 @@ class CommitteeConfig:
         common case loss-tolerant while still saving the n-f-1 wasted
         signs/sends the rotation exists to avoid."""
         return min(self.n, self.weak_quorum + max(1, self.f // 4))
+
+    @property
+    def spec_repliers(self) -> int:
+        """Designated SPECULATIVE-replier set size. A speculative answer
+        needs 2f+1 matching marks (not f+1 — the quorum-intersection
+        argument that makes a spec answer final-safe needs 2f+1
+        preparers on record), so the rotation window is quorum plus the
+        same loss-tolerance spares the final-reply rotation carries."""
+        return min(self.n, self.quorum + max(1, self.f // 4))
 
     def primary(self, view: int) -> str:
         """Round-robin primary rotation (the reference sketched this in its

@@ -74,6 +74,7 @@ from ..messages import (
 )
 from ..transport.base import Transport
 from . import qc as qc_mod
+from .speculation import SpeculationEngine
 from .statesync import StateSync
 from .state import ExecuteBlock, Instance, SendCommit, SendPrepare, Stage
 from .viewchange import (
@@ -289,6 +290,15 @@ class Replica:
         # both the requester side (watermark-gap / NEW-VIEW / cold-start
         # rejoin catch-up) and the server side (peers' chunk requests)
         self.statesync = StateSync(self)
+        # speculative pipelined execution (ISSUE 15, consensus/
+        # speculation.py): blocks execute against a forkable app state
+        # at PREPARED and reply early with a signed speculative mark;
+        # divergence (a view change replacing the block) rolls the
+        # speculated suffix back to the committed anchor. None when the
+        # committee disables it (cfg.speculative=False A/B arms).
+        self.spec: Optional[SpeculationEngine] = (
+            SpeculationEngine(self) if cfg.speculative else None
+        )
         # staged membership change: (activation_seq, new CommitteeConfig).
         # Set by an executed __reconfig__ op; applied when execution
         # reaches the checkpoint boundary activation_seq. Part of
@@ -1367,6 +1377,11 @@ class Replica:
                     node=self.id, view=act.view, seq=act.seq,
                 )
             await self._send_vote(Commit, "commit", act)
+            if self.spec is not None and inst is not None:
+                # the slot just PREPARED here: execute it speculatively
+                # and answer the clients two message delays early
+                # (consensus/speculation.py; rollback covers the loss)
+                await self._send_spec_replies(self.spec.on_prepared(inst))
         elif isinstance(act, ExecuteBlock):
             if act.seq <= self.executed_seq:
                 # a re-issued pre-prepare for an already-executed seq
@@ -1465,10 +1480,24 @@ class Replica:
                     now_pc - src.t_committed,
                     node=self.id, view=act.view, seq=act.seq,
                 )
+            if src is not None and src.t_started:
+                # execute.final: admission -> applied in order — the
+                # full commit latency the speculative reply undercuts
+                # (percentile-comparable against execute.spec)
+                spans.record(
+                    spans.EXECUTE_FINAL,
+                    now_pc - src.t_started,
+                    node=self.id, view=act.view, seq=act.seq,
+                )
             reqs = self._validate_block(act.block, act.digest)
             if reqs is None:  # unreachable: admission validated on entry
                 self.metrics["exec_bad_block"] += 1
                 continue
+            if self.spec is not None:
+                # divergence gate BEFORE the block applies: a speculated
+                # digest losing to the committed one voids the fork
+                self.spec.before_finalize(act)
+            final_results: Dict[Tuple[str, int], str] = {}
             for req in reqs:
                 self.relay_buffer.pop((req.client_id, req.timestamp), None)
                 if req.ack > self.client_ack.get(req.client_id, 0):
@@ -1504,6 +1533,7 @@ class Replica:
                     result = self._execute_reconfig(act.seq, req)
                 else:
                     result = self.app.apply(req.operation)
+                final_results[(req.client_id, req.timestamp)] = result
                 self.metrics["committed_requests"] += 1
                 # one hash decides sampling for BOTH execute and reply
                 trace_rid = (
@@ -1551,6 +1581,10 @@ class Replica:
                         self.tracer.emit(
                             "reply", trace_rid, view=act.view, seq=act.seq
                         )
+            if self.spec is not None:
+                # confirm (or roll back) the slot's speculation, and
+                # keep the fork in lockstep across unspeculated slots
+                self.spec.after_finalize(act, final_results)
             if self.tracer is not None:
                 # executed: the slot's trace binding is complete
                 self.tracer.release_slot(act.view, act.seq)
@@ -1567,6 +1601,11 @@ class Replica:
                     self.pending_reconfig = None
                 await self._emit_checkpoint(self.executed_seq)
             self.vc.reset()  # commits are progress: the primary is alive
+        if self.spec is not None and self.spec.needs_respec:
+            # a rollback during this drain discarded speculation for
+            # slots that are still PREPARED: re-execute the certified
+            # prefix in order and re-answer the clients
+            await self._send_spec_replies(self.spec.re_speculate())
 
     async def _send_superseded(self, view: int, seq: int, req) -> None:
         """Answer with Reply.superseded=1 (see messages.Reply): the
@@ -1592,6 +1631,19 @@ class Replica:
         )
         self._auth_reply(reply)
         await self.transport.send(req.client_id, reply.to_wire())
+
+    async def _send_spec_replies(self, replies) -> None:
+        """Authenticate and transmit speculative replies (Reply.spec=1)
+        the speculation engine produced. NEVER cached in recent_replies:
+        the reply cache is checkpoint state, and speculative results
+        must not leak into a checkpoint digest — retries are answered
+        from the final reply once it lands."""
+        if not replies:
+            return
+        for reply in replies:
+            self._auth_reply(reply)
+            self.metrics["spec_replies_sent"] += 1
+            await self.transport.send(reply.client_id, reply.to_wire())
 
     # ------------------------------------------------------------------
     # live membership reconfiguration (ISSUE 7 tentpole, pillar 3)
@@ -1697,6 +1749,11 @@ class Replica:
             # it the new membership and an epoch marker in the ledger
             self.auditor.on_epoch(new_cfg)
         self._reconcile_boundary_instances(new_cfg)
+        if self.spec is not None:
+            # slots above the boundary were refiltered to the new
+            # epoch's quorum and may no longer be prepared: their
+            # speculation is unjustified until they re-prepare
+            self.spec.on_epoch(self.executed_seq)
         log.info(
             "%s: epoch %d -> %d (n=%d%s)",
             self.id, old.epoch, new_cfg.epoch, new_cfg.n,
@@ -1798,7 +1855,16 @@ class Replica:
 
         return json.dumps(
             {
-                "app": self.app.snapshot(),
+                # the COMMITTED application state only — the speculation
+                # engine's checkpoint surface is fork-blind by
+                # construction (consensus/speculation.py holds the
+                # invariant and the spec_leak planted defect that
+                # violates it for the sim oracle's benefit)
+                "app": (
+                    self.spec.checkpoint_app_snapshot()
+                    if self.spec is not None
+                    else self.app.snapshot()
+                ),
                 # the MEMBERSHIP is replicated state too (ISSUE 7): a
                 # state-transferred joiner must restore the exact epoch
                 # its peers run, and a staged-but-unactivated reconfig
@@ -2514,7 +2580,14 @@ class Replica:
                     int(pend["activate_at"]),
                     config_from_doc(self.cfg, pend["config"]),
                 )
-            self.app.restore(app_snap)  # last: commit point
+            # last: commit point. Restore THROUGH the speculation
+            # engine's ForkableApp when speculation is on: the wrapper
+            # drops the speculative fork atomically with the committed
+            # anchor move (on_state_transfer below then reconciles the
+            # slot bookkeeping)
+            (self.spec.app if self.spec is not None else self.app).restore(
+                app_snap
+            )
             self.client_watermark = new_wm
             self.client_ack = new_ack
             self.recent_replies = restored
@@ -2534,6 +2607,9 @@ class Replica:
         self.checkpoint_digests[seq] = digest
         self.ready = {s: a for s, a in self.ready.items() if s > seq}
         self.metrics["state_syncs"] += 1
+        if self.spec is not None:
+            # the committed anchor jumped under every open speculation
+            self.spec.on_state_transfer(seq)
         self._advance_stable(seq)
         await self._execute_ready()  # buffered blocks beyond the snapshot
         await self._replay_vc_buffer()

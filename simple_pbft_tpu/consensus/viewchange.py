@@ -1128,12 +1128,25 @@ class ViewChanger:
         # hold has_outstanding_work() true forever
         r.prune_stale_block_pending(new_view)
 
-        max_seq = r.stable_seq
-        missing: List[str] = []
+        decoded_pps: List[PrePrepare] = []
         for rd in nv.pre_prepares:
             pp = _decode(rd, PrePrepare)
-            if pp is None:  # validated already; defensive
-                continue
+            if pp is not None:  # validated already; defensive
+                decoded_pps.append(pp)
+        spec = getattr(r, "spec", None)
+        if spec is not None:
+            # speculative-divergence detection (ISSUE 15): the O-set is
+            # the certified truth for every in-window slot — any
+            # speculated seq whose digest loses (replaced, or no-op
+            # filled, or beyond the O-set horizon) walks the speculated
+            # suffix back to the committed anchor BEFORE the re-issues
+            # replay and re-prepare
+            spec.on_new_view_install(
+                [(pp.seq, pp.digest) for pp in decoded_pps]
+            )
+        max_seq = r.stable_seq
+        missing: List[str] = []
+        for pp in decoded_pps:
             max_seq = max(max_seq, pp.seq)
             # resolve the detached block: no-op digests fill trivially,
             # known digests fill from the store, unknown ones go through

@@ -36,7 +36,7 @@ from typing import ClassVar, Dict, List, Optional, Sequence, Set, Tuple
 
 from . import clock
 from .crypto.signer import Signer
-from .messages import Checkpoint, Message, PrePrepare, sha256_hex
+from .messages import Checkpoint, Message, PrePrepare, QuorumCert, sha256_hex
 from .transport import base as base_transport
 
 # The authoritative fault-kind registry: kind -> one-line description.
@@ -92,6 +92,14 @@ KIND_REGISTRY: Dict[str, str] = {
         "arm a ForgedSnapshotServer on the target: state-transfer "
         "chunks it serves are corrupted — a joiner must detect the "
         "digest mismatch and re-fetch from another peer"
+    ),
+    "spec_divergence": (
+        "arm a SpecDivergencePrimary on the target (QC-mode primary): "
+        "every k-th slot's prepare QC is revealed to a SINGLE victim "
+        "and the commit QC withheld — the victim speculates a block "
+        "the rest of the committee never prepared, and the fork is "
+        "only revealed when a view change may no-op the slot "
+        "(speculative rollback, consensus/speculation.py)"
     ),
 }
 
@@ -158,6 +166,7 @@ class FaultSchedule:
         wan: str = "",
         stale_epoch_voters: int = 0,
         statesync_forgers: int = 0,
+        spec_divergers: int = 0,
         replica_ids: Sequence[str] = (),
         drop_rate: float = 0.02,
         delay_s: float = 0.03,
@@ -261,6 +270,10 @@ class FaultSchedule:
             target = rng.choice(list(replica_ids)) if replica_ids else ""
             events.append(FaultEvent(t=t, kind="forge_statesync",
                                      target=target))
+        for t in times(spec_divergers):
+            # "" = the live primary at fire time: withholding quorum
+            # aggregates is a PRIMARY power (QC mode), like equivocation
+            events.append(FaultEvent(t=t, kind="spec_divergence"))
         events.extend(extra_events)
         events.sort(key=lambda e: (e.t, e.kind, e.target, e.spec))
         return cls(seed=seed, horizon=horizon, events=tuple(events))
@@ -281,6 +294,10 @@ class FaultSchedule:
         "partitions": "count of GENERATED random partition windows",
         "stale": "count of stale_epoch events",
         "forgesync": "count of forge_statesync events",
+        "specdiv": (
+            "count of spec_divergence events (QC-mode speculative "
+            "plane, ISSUE 15)"
+        ),
         "wan": "WAN profile name applied at t=0 (wan3dc, lossy, ...)",
         "stall_s": "stall_device duration seconds",
         "drop_rate": "drop_window base rate",
@@ -345,6 +362,7 @@ class FaultSchedule:
             wan=scalars.get("wan", ""),
             stale_epoch_voters=int(scalars.get("stale", 0)),
             statesync_forgers=int(scalars.get("forgesync", 0)),
+            spec_divergers=int(scalars.get("specdiv", 0)),
             replica_ids=replica_ids,
             drop_rate=float(scalars.get("drop_rate", 0.02)),
             delay_s=float(scalars.get("delay_s", 0.03)),
@@ -1016,6 +1034,114 @@ class StaleEpochVoter(ByzantineTransport):
         await self._inner.broadcast(raw, dests)
 
 
+class SpecDivergencePrimary(ByzantineTransport):
+    """Divergence-forcing byzantine primary for the speculative plane
+    (ISSUE 15). In QC mode votes flow only to the primary and the
+    primary distributes the aggregates — total control over who learns
+    a slot prepared. For every PERIOD-th slot this wrapper:
+
+    - delivers the slot's PREPARE QC to a single victim (the highest-id
+      backup) instead of broadcasting it — only the victim reaches
+      PREPARED, speculates the block, and answers clients with the
+      speculative mark (never enough marks for a 2f+1 spec quorum, so
+      no client can accept the answer);
+    - withholds the slot's COMMIT QC entirely, so the slot never
+      commits in this view.
+
+    The fork is revealed only at the view change the stalled slot
+    forces: the victim's VIEW-CHANGE carries the prepared proof, and
+    whether the NEW-VIEW's 2f+1-certificate happens to include it
+    decides the slot's fate — included, the speculation confirms;
+    excluded, the O-set no-op-fills the seq and the victim must roll
+    its speculated suffix back to the committed anchor. Both outcomes
+    are correct; the rollback interleaving is what the sim search
+    steers toward (tests/sim_repros/spec_rollback_viewchange.json).
+    Everything is validly signed — detection surfaces are the victim's
+    ``spec_rolled_back`` metric and a clean audit bill (speculation is
+    local; no safety invariant may trip). Non-QC frames pass through
+    untouched, so the wrapper is inert on broadcast-vote committees."""
+
+    PERIOD = 3  # every 3rd seq is a victim slot
+
+    def __init__(self, inner, signer: Signer) -> None:
+        super().__init__(inner, signer)
+        self._victim_of: Dict[int, str] = {}  # seq -> chosen victim
+
+    def _victim_qc(self, raw: bytes) -> Optional[QuorumCert]:
+        if b'"kind":"qc"' not in raw and b'"kind": "qc"' not in raw:
+            return None
+        try:
+            msg = Message.from_wire(raw)
+        except ValueError:
+            return None
+        if (
+            isinstance(msg, QuorumCert)
+            and msg.seq % self.PERIOD == 0
+            and msg.phase in ("prepare", "commit")
+        ):
+            return msg
+        return None
+
+    def _strip_vc(self, raw: bytes) -> bytes:
+        """Lie by omission in our own VIEW-CHANGE: drop the prepared
+        proofs for victim slots and re-sign. Without this the wrapper's
+        fork self-reveals — the byzantine primary's honest certificate
+        would carry the victim slot's prepare QC into the O-set and the
+        speculation would simply confirm. Omission is admissible
+        byzantine behavior (a VC is a CLAIM about what its sender
+        prepared), and it is exactly what makes the fork surface only
+        at the view change: with the victim's own VIEW-CHANGE also
+        absent (cut, or outside the 2f+1 certificate), the O-set
+        no-op-fills the slot and the victim must roll back."""
+        if b'"kind":"viewchange"' not in raw and (
+            b'"kind": "viewchange"' not in raw
+        ):
+            return raw
+        try:
+            msg = Message.from_wire(raw)
+        except ValueError:
+            return raw
+        if type(msg).KIND != "viewchange" or msg.sender != self.node_id:
+            return raw
+        kept = []
+        for proof in msg.prepared_proofs:
+            pp = (proof or {}).get("pre_prepare") or {}
+            seq = pp.get("seq")
+            if isinstance(seq, int) and seq % self.PERIOD == 0:
+                continue
+            kept.append(proof)
+        if len(kept) == len(msg.prepared_proofs):
+            return raw
+        msg.prepared_proofs = kept
+        self.signer.sign_msg(msg)
+        self.injections += 1
+        return msg.to_wire()
+
+    async def send(self, dest, raw):
+        # the repair plane (SlotFetch answers) re-serves stored QCs via
+        # point-to-point sends: a consistent withholder must filter both
+        # paths or one probe round trip un-forks the slot
+        msg = self._victim_qc(raw)
+        if msg is not None:
+            if msg.phase == "commit" or dest != self._victim_of.get(msg.seq):
+                self.injections += 1
+                return
+        await self._inner.send(dest, self._strip_vc(raw))
+
+    async def broadcast(self, raw, dests):
+        msg = self._victim_qc(raw)
+        if msg is not None:
+            self.injections += 1
+            if msg.phase == "commit":
+                return  # withheld: the slot cannot commit in-view
+            victims = sorted(d for d in dests if d != self.node_id)
+            if victims:
+                self._victim_of[msg.seq] = victims[-1]
+                await self._inner.send(victims[-1], raw)
+            return
+        await self._inner.broadcast(self._strip_vc(raw), dests)
+
+
 class ForgedSnapshotServer(ByzantineTransport):
     """Feeds a joiner a forged checkpoint: every outbound state-transfer
     payload (chunked StateChunkReply and legacy StateResponse) has its
@@ -1133,7 +1259,7 @@ class FaultInjector:
         elif ev.kind == "stall_device":
             ok = self._stall(ev)
         elif ev.kind in ("equivocate", "fork_checkpoint", "stale_epoch",
-                         "forge_statesync"):
+                         "forge_statesync", "spec_divergence"):
             ok = self._byzantine(ev)
         elif ev.kind == "partition":
             ok = self._partition(ev)
@@ -1202,6 +1328,7 @@ class FaultInjector:
             "fork_checkpoint": ForkingCheckpointer,
             "stale_epoch": StaleEpochVoter,
             "forge_statesync": ForgedSnapshotServer,
+            "spec_divergence": SpecDivergencePrimary,
         }[ev.kind]
         if isinstance(r.transport, cls):
             return False  # already byzantine this way

@@ -130,6 +130,10 @@ class ReplicaStats:
         self.sweep_ms = Histogram()
         self.verify_ms = Histogram()
         self.commit_ms = Histogram()
+        # speculative-reply latency (ISSUE 15): pre-prepare admission ->
+        # speculative reply sent — the client-visible half of commit
+        # latency under speculation; compare p50 against commit_ms
+        self.spec_reply_ms = Histogram()
         self.verify_items = 0
         self.verify_seconds = 0.0
         self._started = time.perf_counter()
@@ -152,6 +156,7 @@ class ReplicaStats:
             "verify_ms": self.verify_ms.summary(),
             "verify_per_s": round(self.verifies_per_sec(), 1),
             "commit_ms": self.commit_ms.summary(),
+            "spec_reply_ms": self.spec_reply_ms.summary(),
         }
         if metrics is not None:
             doc["metrics"] = dict(sorted(metrics.items()))

@@ -395,6 +395,16 @@ class Reply(Message):
     #: an in-band reserved result string — nothing stops an application
     #: from legitimately storing/returning any string.
     superseded: int = 0
+    #: 1 = SPECULATIVE (ISSUE 15): the executing replica applied the
+    #: block at PREPARED, before the commit certificate formed. The mark
+    #: is signed (it rides the payload like every field), so a client
+    #: can count 2f+1 matching speculative replies as a fast answer —
+    #: 2f+1 speculators means 2f+1 replicas PREPARED the slot, and by
+    #: quorum intersection no future view can install a different block
+    #: there — while final (spec=0) replies from the same replicas
+    #: upgrade, never double-count (client._on_reply dedupes per sender
+    #: with the stricter mark winning).
+    spec: int = 0
     #: committee configuration epoch the executing replica was in
     #: (ISSUE 7: live membership reconfiguration). A client holding a
     #: stale address book sees epoch > its own in any reply and

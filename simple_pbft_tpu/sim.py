@@ -255,6 +255,11 @@ class Scenario:
     gen: Dict[str, Any] = field(default_factory=dict)  # generate() kwargs
     qc_mode: bool = False
     verify_signatures: bool = True
+    # speculative execution (ISSUE 15). Repro artifacts recorded BEFORE
+    # the feature carry {"speculative": false} so they replay the exact
+    # interleaving that was minimized (speculative reply traffic shifts
+    # every downstream virtual timestamp); new scenarios default on.
+    speculative: bool = True
     view_timeout: float = 1.0
     checkpoint_interval: int = 16
     watermark_window: int = 256
@@ -293,6 +298,7 @@ class Scenario:
             "schedule": self.resolved_schedule().summary(),
             "qc_mode": self.qc_mode,
             "verify_signatures": self.verify_signatures,
+            "speculative": self.speculative,
             "view_timeout": self.view_timeout,
             "checkpoint_interval": self.checkpoint_interval,
             "watermark_window": self.watermark_window,
@@ -315,6 +321,7 @@ class Scenario:
             schedule=FaultSchedule.from_summary(doc["schedule"]),
             qc_mode=bool(doc.get("qc_mode", False)),
             verify_signatures=bool(doc.get("verify_signatures", True)),
+            speculative=bool(doc.get("speculative", True)),
             view_timeout=float(doc.get("view_timeout", 1.0)),
             checkpoint_interval=int(doc.get("checkpoint_interval", 16)),
             watermark_window=int(doc.get("watermark_window", 256)),
@@ -375,6 +382,11 @@ def coverage_key(cov: Dict[str, int]) -> Tuple[int, ...]:
         # 4 beyond (the near-wedge tail the search should dwell in)
         next((i for i, edge in enumerate((5, 30, 90, 240))
               if int(cov.get("probe_s", 0)) <= edge), 4),
+        # speculative plane (ISSUE 15): did anything speculate, and did
+        # a ROLLBACK fire — the ramp the search climbs toward
+        # rollback-during-reconfig-during-view-change interleavings
+        int(cov.get("spec_executed", 0) > 0),
+        bucket(int(cov.get("spec_rolled_back", 0))),
     )
 
 
@@ -417,6 +429,7 @@ async def _pump(client, sc: Scenario, idx: int, stats: Dict[str, int]) -> None:
 
 async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
     from .committee import LocalCommittee
+    from .consensus import speculation as speculation_mod
     from .consensus import statesync as statesync_mod
 
     t0_wall = time.monotonic()
@@ -429,6 +442,7 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
         view_timeout=sc.view_timeout,
         checkpoint_interval=sc.checkpoint_interval,
         watermark_window=sc.watermark_window,
+        speculative=sc.speculative,
     )
 
     def _tap(src: str, dst: str, kind: str, nbytes: int, verdict: str) -> None:
@@ -442,6 +456,10 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
         auditors = com.attach_auditors(log_dir=sc.audit_dir)
     prev_defects = set(statesync_mod.DEFECTS)
     statesync_mod.DEFECTS |= set(sc.defects)
+    # planted-defect registries are per-module; the scenario's defect
+    # list feeds them all (unknown names are simply inert in each)
+    prev_spec_defects = set(speculation_mod.DEFECTS)
+    speculation_mod.DEFECTS |= set(sc.defects)
     schedule = sc.resolved_schedule()
     injector = FaultInjector(committee=com, schedule=schedule)
     failure: Optional[str] = None
@@ -497,6 +515,8 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
     finally:
         statesync_mod.DEFECTS.clear()
         statesync_mod.DEFECTS |= prev_defects
+        speculation_mod.DEFECTS.clear()
+        speculation_mod.DEFECTS |= prev_spec_defects
         for a in auditors.values():
             a.close()
 
@@ -513,11 +533,51 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
             agreed.setdefault(seq, digest)
     if divergent_seq is not None:
         failure = f"safety:commit-divergence@seq{divergent_seq}"
+    # speculative-leak oracle (ISSUE 15): checkpoint digests are a
+    # deterministic function of COMMITTED history, identical on every
+    # honest replica at the same seq — replicas speculate on different
+    # timings, so any leak of speculative state into a checkpoint
+    # snapshot diverges the digests instantly. This is the
+    # machine-checkable form of "speculative state never leaks into a
+    # checkpoint digest".
+    cp_by_seq: Dict[int, str] = {}
+    cp_divergent: Optional[int] = None
+    for r in honest:
+        for seq, dg in r.checkpoint_digests.items():
+            if seq in cp_by_seq and cp_by_seq[seq] != dg:
+                cp_divergent = seq
+            cp_by_seq.setdefault(seq, dg)
+    if cp_divergent is not None and failure is None:
+        failure = f"safety:checkpoint-divergence@seq{cp_divergent}"
+    # ...and never into a committed reply: the replicated reply cache is
+    # checkpoint state, so a speculative mark inside it would both leak
+    # and replay a possibly-rolled-back result to retrying clients
+    if failure is None and any(
+        getattr(rep, "spec", 0)
+        for r in honest
+        for per in r.recent_replies.values()
+        for rep in per.values()
+    ):
+        failure = "safety:spec-reply-in-committed-cache"
     violations = sum(
         getattr(auditors.get(r.id), "violations", 0) for r in honest
     )
     if violations and not byz and failure is None:
         failure = "safety:unexpected-evidence"
+    # an HONEST replica accused by honest auditors is a safety bug
+    # regardless of injected byzantine company: the injectors sign their
+    # own lies, so evidence naming anyone else means a replica's
+    # replicated state genuinely diverged (the ISSUE 15 leak shape:
+    # speculative state reaching a checkpoint digest shows up exactly
+    # here, as checkpoint-divergence evidence among honest nodes)
+    accused_union: set = set()
+    for r in honest:
+        accused_union |= set(
+            getattr(auditors.get(r.id), "accused_ever", ()) or ()
+        )
+    honest_accused = sorted(accused_union - set(byz))
+    if honest_accused and failure is None:
+        failure = f"safety:honest-accused:{','.join(honest_accused)}"
     app_digests = {}
     for r in honest:
         snap = r.app.snapshot()
@@ -564,6 +624,16 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
         "probe_s": pump_stats.get("probe_s", 0),
         "crashes": injector.crashes_applied,
         "faults_applied": injector.applied_count,
+        # speculative plane (ISSUE 15): slots executed at PREPARED and
+        # slots walked back — the rollback count is the novelty signal
+        # the schedule search steers toward (rollback-during-reconfig-
+        # during-view-change interleavings live behind it)
+        "spec_executed": sum(
+            r.metrics.get("spec_executed", 0) for r in com.replicas
+        ),
+        "spec_rolled_back": sum(
+            r.metrics.get("spec_rolled_back", 0) for r in com.replicas
+        ),
     }
     # fold the consensus outcome into the trace so the fingerprint
     # covers protocol RESULTS, not just wire traffic

@@ -25,6 +25,9 @@ no dict is built unless the span is exported or persisted. Stages:
   phase.prepare      pre-prepare admission -> slot prepared
   phase.commit       prepared -> commit certificate formed
   phase.execute      commit certificate -> applied in order
+  execute.spec       admission -> speculative reply sent (ISSUE 15)
+  execute.final      admission -> applied in order (the same slot's
+                     full commit latency, comparable against spec)
   transport.queue    local-transport residency (enqueue -> recv), fault
                      delay included — the wire's contribution
   client.e2e         client submit -> f+1 accepted
@@ -66,6 +69,14 @@ REPLICA_VERIFY_WAIT = "replica.verify_wait"
 PHASE_PREPARE = "phase.prepare"
 PHASE_COMMIT = "phase.commit"
 PHASE_EXECUTE = "phase.execute"
+# the phase.execute split (ISSUE 15): both measured from pre-prepare
+# ADMISSION so their percentiles are directly comparable — the gap
+# between p50(execute.spec) and p50(execute.final) IS the speculative
+# win. phase.execute keeps its commit-cert→applied meaning (the tiling/
+# reconciliation contract below depends on it); these two are the
+# attribution overlay, not a rename.
+EXECUTE_SPEC = "execute.spec"      # admission -> speculative reply sent
+EXECUTE_FINAL = "execute.final"    # admission -> applied in order
 TRANSPORT_QUEUE = "transport.queue"
 CLIENT_E2E = "client.e2e"
 

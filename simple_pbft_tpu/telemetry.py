@@ -258,6 +258,15 @@ def replica_snapshot(replica) -> Dict[str, Any]:
         "ready_holes": len(replica.ready),
         "metrics": dict(sorted(replica.metrics.items())),
         "stats": replica.stats.snapshot(),
+        # speculative-execution engine state (ISSUE 15): open slot
+        # count + fork posture; the spec_executed/spec_rolled_back
+        # counters ride the metrics dict and spec_reply_ms the stats
+        # block — pbft_top's SPEC column reads all three
+        "spec": (
+            replica.spec.snapshot()
+            if getattr(replica, "spec", None) is not None
+            else None
+        ),
     }
 
 

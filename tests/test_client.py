@@ -41,9 +41,9 @@ def run(coro, timeout=30):
     return asyncio.run(asyncio.wait_for(coro, timeout))
 
 
-def _reply(rid, result, ts=1, view=0):
+def _reply(rid, result, ts=1, view=0, spec=0):
     return Reply(sender=rid, view=view, seq=1, client_id="c0", timestamp=ts,
-                 result=result)
+                 result=result, spec=spec)
 
 
 def test_forged_replies_never_match_and_valid_ones_do():
@@ -123,6 +123,119 @@ def test_late_replies_after_match_skip_signature_work():
             await t.q.put(msg.to_wire())
         await asyncio.sleep(0.1)
         assert counter.calls == verified_during_match
+        await client.stop()
+
+    run(scenario())
+
+
+def test_spec_reply_upgrade_never_double_counts():
+    """ISSUE 15 reply accounting: a replica that upgrades its
+    speculative reply to final is ONE voice — per-(replica, request)
+    dedupe with the stricter (final) mark winning. n=4: the speculative
+    fast path needs 2f+1 = 3 DISTINCT replicas; a double-counted
+    upgrade would fake the third."""
+
+    async def scenario():
+        cfg, keys = make_test_committee(n=4, clients=1)
+        t = FakeTransport("c0")
+        client = Client(client_id="c0", cfg=cfg, seed=keys["c0"].seed,
+                        transport=t, request_timeout=2.0)
+        client.start()
+        task = asyncio.create_task(client.submit("op s", retries=0))
+        await asyncio.sleep(0.05)
+        (ts,) = client._waiters.keys()
+
+        async def put(rid, spec):
+            msg = _reply(rid, "ok", ts=ts, spec=spec)
+            Signer(rid, keys[rid].seed).sign_msg(msg)
+            await t.q.put(msg.to_wire())
+
+        # two speculative replies, then the SAME replica upgrades to
+        # final: still only two distinct replicas — no quorum of any
+        # kind may form (2 < f+1 finals is false... 1 final < 2; and
+        # 2 distinct marks < 3 spec quorum)
+        await put("r0", spec=1)
+        await put("r1", spec=1)
+        await put("r0", spec=0)  # upgrade, not a third voice
+        # ...and a late speculative copy must not downgrade the final
+        await put("r0", spec=1)
+        await asyncio.sleep(0.2)
+        assert not task.done(), "double-counted replica reached a quorum"
+        # final won, recorded at its slot identity
+        assert client._replies[ts]["r0"] == ("ok", False, False, 1, 0)
+        # a third DISTINCT replica completes the 2f+1 speculative quorum
+        await put("r2", spec=1)
+        assert await task == "ok"
+        assert client.metrics.get("spec_accepted") == 1
+        # final-commit confirmation retained: f+1 final replies upgrade
+        # the fast answer (r0 final already counted; r1's arrives now)
+        await put("r1", spec=0)
+        await asyncio.sleep(0.2)
+        assert client.metrics.get("final_confirms") == 1
+        assert not client._confirming
+        await client.stop()
+
+    run(scenario())
+
+
+def test_spec_marks_across_slots_never_pool_into_a_quorum():
+    """The speculative quorum is PER-SLOT: 2f+1 speculators of one slot
+    are 2f+1 preparers of that slot (the quorum-intersection safety
+    argument). Marks for the same request speculated at DIFFERENT seqs
+    across failover re-proposals — each slot with <= f preparers — must
+    never pool into a fake 2f+1."""
+
+    async def scenario():
+        cfg, keys = make_test_committee(n=4, clients=1)
+        t = FakeTransport("c0")
+        client = Client(client_id="c0", cfg=cfg, seed=keys["c0"].seed,
+                        transport=t, request_timeout=2.0)
+        client.start()
+        task = asyncio.create_task(client.submit("op x", retries=0))
+        await asyncio.sleep(0.05)
+        (ts,) = client._waiters.keys()
+
+        async def put(rid, seq, spec=1):
+            msg = Reply(sender=rid, view=0, seq=seq, client_id="c0",
+                        timestamp=ts, result="ok", spec=spec)
+            Signer(rid, keys[rid].seed).sign_msg(msg)
+            await t.q.put(msg.to_wire())
+
+        # three distinct replicas, same result — but three DIFFERENT
+        # slots: no 2f+1 quorum exists for any one slot
+        await put("r0", seq=1)
+        await put("r1", seq=2)
+        await put("r2", seq=3)
+        await asyncio.sleep(0.2)
+        assert not task.done(), "cross-slot marks pooled into a quorum"
+        # a third mark for slot 2 completes a real per-slot quorum
+        await put("r0", seq=2)
+        await put("r3", seq=2)
+        assert await task == "ok"
+        await client.stop()
+
+    run(scenario())
+
+
+def test_final_quorum_still_resolves_without_speculation():
+    """Plain f+1 final matching is untouched: two final replies resolve
+    at n=4 with no speculative reply in sight."""
+
+    async def scenario():
+        cfg, keys = make_test_committee(n=4, clients=1)
+        t = FakeTransport("c0")
+        client = Client(client_id="c0", cfg=cfg, seed=keys["c0"].seed,
+                        transport=t, request_timeout=2.0)
+        client.start()
+        task = asyncio.create_task(client.submit("op f", retries=0))
+        await asyncio.sleep(0.05)
+        (ts,) = client._waiters.keys()
+        for rid in ("r0", "r1"):
+            msg = _reply(rid, "done", ts=ts)
+            Signer(rid, keys[rid].seed).sign_msg(msg)
+            await t.q.put(msg.to_wire())
+        assert await task == "done"
+        assert client.metrics.get("spec_accepted", 0) == 0
         await client.stop()
 
     run(scenario())

@@ -75,6 +75,13 @@ def test_lost_commit_shares_repaired_without_view_change():
             # failover timer far beyond the test: recovery must come
             # from vote retransmission, not a view change
             view_timeout=60.0,
+            # speculation off: every replica PREPARES this slot, so the
+            # speculative fast path would answer the client before the
+            # stalled commit quorum even matters — this test pins the
+            # resend REPAIR path, which needs the client blocked on the
+            # final commit (tests/test_speculation.py covers the fast
+            # answer itself)
+            speculative=False,
         )
         com.start()
         c = com.clients[0]

@@ -383,6 +383,15 @@ def test_client_retry_recovers_after_partition_exactly_once():
             assert await client.submit("put k recovered", retries=10) == "ok"
             assert client.metrics["retransmissions"] >= 1
             assert client.metrics["recovered_after_retry"] == 1
+            # submit may resolve on the 2f+1 SPECULATIVE quorum (ISSUE
+            # 15) before the commit certificates land: settle, then pin
+            # exactly-once execution
+            for _ in range(100):
+                if all(
+                    r.metrics.get("committed_requests") for r in com.replicas
+                ):
+                    break
+                await asyncio.sleep(0.05)
             for r in com.replicas:
                 assert r.metrics["committed_requests"] == 1
         finally:

@@ -332,6 +332,32 @@ def test_planted_defect_wedge_repro():
     assert fixed.ok, fixed.failure
 
 
+def test_spec_rollback_viewchange_repro():
+    """ISSUE 15: rollback-under-view-change, both ways. The schedule
+    (ddmin-minimized: wan3dc shaping + a spec_divergence primary + the
+    victim's outbound cut) makes a replica speculate a PREPARED block
+    whose slot the NEW-VIEW then no-op-fills — a real rollback fires on
+    the fixed code and the run is clean (zero safety-oracle failures,
+    zero honest-node audit evidence). With the ``spec_leak`` planted
+    defect armed (rollback leaves checkpoint snapshots reading the
+    speculative fork), the SAME schedule fails the safety oracle:
+    honest checkpoint digests diverge and the audit plane's I2
+    invariant accuses honest nodes. Triage: docs/SCENARIOS.md."""
+    doc = load_repro("spec_rollback_viewchange.json")
+    sc = scenario_from_artifact(doc)
+    assert "spec_leak" in sc.defects  # recorded as found
+    leaky = run_scenario(sc)
+    assert not leaky.ok
+    assert leaky.failure_class == "safety", leaky.failure
+    # the same schedule on the fixed code: clean, with the rollback
+    # genuinely exercised (this is a ROLLBACK repro, not just a leak
+    # repro — spec slots were discarded on the NEW-VIEW install)
+    fixed = run_scenario(replace(sc, defects=()))
+    assert fixed.ok, fixed.failure
+    assert fixed.coverage.get("spec_rolled_back", 0) > 0
+    assert fixed.coverage.get("spec_executed", 0) > 0
+
+
 # ---------------------------------------------------------------------------
 # explorer plumbing
 # ---------------------------------------------------------------------------

@@ -364,6 +364,12 @@ def test_final_dump_path_writes_autopsy(tmp_path):
         r0 = com.replica("r0")
         try:
             assert await com.clients[0].submit("put k v") == "ok"
+            # settle past the speculative fast answer (ISSUE 15): the
+            # final dump below must snapshot a COMMITTED request
+            for _ in range(100):
+                if r0.metrics.get("committed_requests"):
+                    break
+                await asyncio.sleep(0.05)
             wd = ProgressWatchdog(
                 com.node_telemetry("r0"),
                 path=str(tmp_path / "r0.autopsy.json"),

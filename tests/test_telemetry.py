@@ -79,6 +79,13 @@ def test_snapshot_absorbs_all_four_surfaces_after_traffic():
         com.start()
         try:
             assert await com.clients[0].submit("put k v") == "ok"
+            # the submit may resolve on the speculative fast path
+            # (ISSUE 15): settle until r0's commit lands
+            r0 = com.replica("r0")
+            for _ in range(100):
+                if r0.metrics.get("committed_requests"):
+                    break
+                await asyncio.sleep(0.05)
             snap = com.node_telemetry("r0").snapshot()
             rep = snap["replica"]
             assert rep["metrics"]["committed_requests"] == 1
@@ -329,6 +336,12 @@ def test_bench_committee_telemetry_aggregate():
         com.start()
         try:
             assert await com.clients[0].submit("put k v") == "ok"
+            # settle past the speculative fast answer (ISSUE 15): the
+            # aggregate must see every replica's commit applied
+            for _ in range(100):
+                if all(r.executed_seq >= 1 for r in com.replicas):
+                    break
+                await asyncio.sleep(0.05)
             agg = bench_consensus._committee_telemetry(com)
             assert agg["schema"] == SCHEMA_VERSION
             assert agg["replicas_running"] == 4

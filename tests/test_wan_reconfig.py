@@ -857,8 +857,18 @@ class TestStatesyncSoloMode:
                 victim.kill()
                 for i in range(10):
                     await c.submit(f"put m{i} {big}", retries=5)
-                # the live snapshot now spans >= 2 chunks
+                # the live snapshot now spans >= 2 chunks. Settle: the
+                # speculative fast path (ISSUE 15) answers submits
+                # before the commit wave executes, so the checkpoint
+                # that cuts the big snapshot may still be in flight
                 donor = com.replica("r0")
+                for _ in range(200):
+                    if any(
+                        len(s) > CHUNK_BYTES
+                        for s in donor.snapshots.values()
+                    ):
+                        break
+                    await asyncio.sleep(0.05)
                 assert any(
                     len(s) > CHUNK_BYTES for s in donor.snapshots.values()
                 )

@@ -64,6 +64,12 @@ METRICS: Dict[str, Tuple[int, float]] = {
     "full_run_req_s": (+1, 0.25),
     "p50_ms": (-1, 0.35),
     "p99_ms": (-1, 0.50),
+    # speculative-reply latency (ISSUE 15): the client-visible fast
+    # answer — regresses UP only (an improvement never flags), same
+    # wall-clock noise floor as p50_ms. Cells whose reference predates
+    # speculation simply never gate it (metric absent from reference).
+    "p50_spec_latency_ms": (-1, 0.35),
+    "p99_spec_latency_ms": (-1, 0.50),
     "wire.per_commit.total_msgs_per_slot": (-1, 0.15),
     "wire.per_commit.total_bytes_per_slot": (-1, 0.20),
     "wire.per_commit.total_msgs_per_req": (-1, 0.25),

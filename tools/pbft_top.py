@@ -36,7 +36,7 @@ from typing import Dict, List, Optional, Tuple
 COLUMNS = (
     "NODE", "SRC", "VIEW", "ROLE", "EXEC", "STABLE", "CAGE", "BACKLOG",
     "VQ", "QCQ", "QCB", "PAIRms", "SHED", "DEG", "QUAR", "REJ", "WDOG",
-    "AUD", "NET", "NETIO", "DEV", "RTTms", "LAGms", "REQ/s",
+    "AUD", "SPEC", "NET", "NETIO", "DEV", "RTTms", "LAGms", "REQ/s",
 )
 
 
@@ -84,6 +84,29 @@ def dev_cell(snap: dict) -> str:
         f"{_fmt_rate(dev.get('verifies_per_s_effective', 0))}v/s "
         f"{dev.get('pad_waste_pct', 0):.0f}%"
     )
+
+
+def spec_cell(snap: dict) -> str:
+    """SPEC: speculative-execution posture (ISSUE 15) —
+    ``speculated/rolled-back p50ms`` where the counts are slots executed
+    at PREPARED vs slots walked back on divergence, and the latency is
+    the spec-reply p50 from the stats histogram (admission -> the
+    speculative answer the client can act on). Blank when the node never
+    speculated (speculation disabled, or a pre-ISSUE-15 flight file).
+    A climbing rolled-back count under view-change churn is expected;
+    rolled-back climbing while VIEW is stable is the triage signal
+    (docs/SCENARIOS.md §speculative divergence)."""
+    rep = snap.get("replica") or {}
+    met = rep.get("metrics") or {}
+    ex = met.get("spec_executed", 0)
+    rb = met.get("spec_rolled_back", 0)
+    if not ex and not rb:
+        return ""
+    cell = f"{ex}/{rb}"
+    p50 = ((rep.get("stats") or {}).get("spec_reply_ms") or {}).get("p50")
+    if p50:
+        cell += f" {p50:.0f}ms"
+    return cell
 
 
 def net_cell(snap: dict) -> str:
@@ -258,6 +281,7 @@ def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
         str(ver.get("overload_rejections", "")),
         str(ver.get("watchdog_failovers", "")),
         aud_cell,
+        spec_cell(snap),
         net_cell(snap),
         netio_cell(snap, prev, dt),
         dev_cell(snap),

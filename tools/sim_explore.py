@@ -87,7 +87,9 @@ def base_scenario(args, seed: int) -> Scenario:
     )
 
 
-def sample_gen(rng: random.Random, signed: bool) -> Dict[str, object]:
+def sample_gen(
+    rng: random.Random, signed: bool, qc: bool = False
+) -> Dict[str, object]:
     """Random generate() kwargs for a fresh corpus seed: light faulting,
     weighted toward the network kinds the search mutates well."""
     gen: Dict[str, object] = {}
@@ -98,6 +100,10 @@ def sample_gen(rng: random.Random, signed: bool) -> Dict[str, object]:
         gen["wan"] = rng.choice(("wan3dc", "lossy"))
     if signed and rng.random() < 0.2:
         gen[rng.choice(("equivocators", "checkpoint_forkers"))] = 1
+    if qc and rng.random() < 0.3:
+        # ISSUE 15: the speculative-divergence primary (QC-mode seam) —
+        # prepared-slot withholding whose fork surfaces at view change
+        gen["spec_divergers"] = 1
     return gen
 
 
@@ -129,10 +135,25 @@ def mutate(
     h = sched.horizon
     events: List[FaultEvent] = list(sched.events)
     ops = ["add_partition", "add_crash", "shift", "drop", "extend",
-           "retime_dup", "flip_chain"]
+           "retime_dup", "flip_chain", "add_divergence"]
     if not events:
         ops = ["add_partition", "add_crash"]
     op = rng.choice(ops)
+    if op == "add_divergence":
+        # ISSUE 15: arm the speculative-divergence primary early and
+        # crash it later — the schedule shape whose view change may
+        # no-op a speculated slot (rollback-during-view-change; compose
+        # with partitions/reconfig via further mutation rounds). Inert
+        # on non-QC scenarios (the wrapper passes non-QC frames).
+        t0 = round(rng.uniform(0.03 * h, 0.4 * h), 3)
+        events.append(FaultEvent(t=t0, kind="spec_divergence"))
+        events.append(FaultEvent(
+            t=round(min(0.85 * h, t0 + rng.uniform(0.1 * h, 0.4 * h)), 3),
+            kind="crash",
+        ))
+        events.sort(key=lambda e: (e.t, e.kind, e.target, e.spec))
+        return FaultSchedule(seed=sched.seed, horizon=h,
+                             events=tuple(events))
     if op == "flip_chain":
         # structured operator: take an existing cut and OVERLAP its
         # complementary direction on one member — "hear but can't
@@ -299,7 +320,7 @@ def mode_sweep(args) -> Dict:
         seed = args.seed_base + i
         sc = base_scenario(args, seed)
         sc = replace(sc, gen=sample_gen(random.Random(seed ^ 0xC0FFEE),
-                                        args.signed))
+                                        args.signed, qc=args.qc))
         if args.audit_every and i % args.audit_every == 0:
             res, code = audited_run(sc)
             stats["audits"] += 1
@@ -350,7 +371,7 @@ def mode_search(args) -> Dict:
             for _ in range(rng.randrange(0, 2)):
                 sched = mutate(rng, sched, ids)
         else:
-            gen = sample_gen(rng, args.signed)
+            gen = sample_gen(rng, args.signed, qc=args.qc)
             sched = FaultSchedule.generate(
                 seed=seed, horizon=args.horizon, replica_ids=ids, **gen
             )
