@@ -26,6 +26,7 @@ class LocalCommittee:
     replicas: List[Replica] = field(default_factory=list)
     clients: List[Client] = field(default_factory=list)
     lag_gauge: Optional[object] = None  # LoopLagGauge (attach_loop_lag)
+    traffic_stats: Optional[object] = None  # workload.TrafficStats (ISSUE 17)
 
     @staticmethod
     def build(
@@ -34,11 +35,21 @@ class LocalCommittee:
         fault_plan: Optional[FaultPlan] = None,
         verifier_factory=None,
         app_factory=KVStore,
+        shed_watermark: int = 0,
+        max_drain: int = 0,
         **cfg_overrides,
     ) -> "LocalCommittee":
         cfg, keys = make_test_committee(n=n, clients=clients, **cfg_overrides)
         net = LocalNetwork(fault_plan)
         committee = LocalCommittee(cfg=cfg, keys=keys, net=net)
+        # shed-plane knobs forward only when set: Replica's defaults are
+        # production-sized, and sim scenarios shrink them to make the
+        # overload seams reachable at sim scale (ISSUE 17)
+        shed_kw = {}
+        if shed_watermark:
+            shed_kw["shed_watermark"] = shed_watermark
+        if max_drain:
+            shed_kw["max_drain"] = max_drain
         for rid in cfg.replica_ids:
             committee.replicas.append(
                 Replica(
@@ -48,6 +59,7 @@ class LocalCommittee:
                     transport=net.endpoint(rid),
                     app=app_factory(),
                     verifier=verifier_factory() if verifier_factory else None,
+                    **shed_kw,
                 )
             )
         for i in range(clients):
@@ -104,12 +116,14 @@ class LocalCommittee:
                 return NodeTelemetry(
                     node_id, replica=r, transport=r.transport,
                     tracer=r.tracer, loop_lag=self.lag_gauge,
+                    traffic=self.traffic_stats,
                 )
         for c in self.clients:
             if c.id == node_id:
                 return NodeTelemetry(
                     node_id, client=c, transport=c.transport,
                     tracer=c.tracer, loop_lag=self.lag_gauge,
+                    traffic=self.traffic_stats,
                 )
         raise KeyError(node_id)
 

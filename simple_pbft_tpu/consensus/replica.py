@@ -33,7 +33,7 @@ import hashlib
 import logging
 import threading
 from collections import OrderedDict, defaultdict
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .. import clock, spans
 from ..app import Application, KVStore
@@ -117,6 +117,19 @@ STALE_FOLD_INTERVALS = 16
 # in messages.DEFERRABLE — one source shared with the TCP transport's
 # mid-write/drain policy so the two can't drift.
 SHED_DEFERRABLE = DEFERRABLE
+
+# Planted-defect registry for deterministic-simulation search (ISSUE 17;
+# same contract as statesync.DEFECTS / speculation.DEFECTS): names are
+# armed by sim scenarios to re-introduce specific bug shapes so the
+# load-shape search can prove it FINDS them. Never set in production.
+#
+# - "shed_bulk_bias": _shed_for_overload fills the deferrable budget
+#   biggest-payload-first instead of arrival-order ("maximize work kept
+#   per slot" — a plausible throughput hack), so padded bulk requests
+#   monopolize the budget under sustained overload and the interactive
+#   class starves: the fairness bug the slo:starved-class oracle exists
+#   to catch.
+DEFECTS: Set[str] = set()
 
 # Membership reconfiguration rides the ordinary request path as a
 # specially-prefixed operation (docs/SCENARIOS.md): deterministic
@@ -602,6 +615,12 @@ class Replica:
         budget = max(0, self.shed_watermark - len(critical))
         kept = critical
         deferred = [m for m in decoded if isinstance(m, SHED_DEFERRABLE)]
+        if "shed_bulk_bias" in DEFECTS:
+            # planted fairness bug (see DEFECTS): biggest payload first
+            deferred = sorted(
+                deferred,
+                key=lambda m: -len(getattr(m, "operation", "") or ""),
+            )
         if budget:
             # arrival order preserved within the class; the merge below
             # keeps overall order too (stable filter + index sort)

@@ -38,6 +38,12 @@ from . import clock
 from .crypto.signer import Signer
 from .messages import Checkpoint, Message, PrePrepare, QuorumCert, sha256_hex
 from .transport import base as base_transport
+from .workload import (
+    WORKLOAD_KINDS,
+    WorkloadEvent,
+    workload_event_from_dict,
+    workload_kind_table,
+)
 
 # The authoritative fault-kind registry: kind -> one-line description.
 # EVERYTHING that names the kind set (module/class docstrings, parse
@@ -144,11 +150,16 @@ class FaultEvent:
 
 @dataclass(frozen=True)
 class FaultSchedule:
-    """An immutable, seed-deterministic list of FaultEvents."""
+    """An immutable, seed-deterministic list of FaultEvents, plus (since
+    schema v3 / ISSUE 17) the run's WorkloadEvents: one schedule object
+    IS the complete replay tuple — faults AND load shape — so sim repro
+    artifacts, bench ledger lines and ddmin minimization treat both
+    planes uniformly."""
 
     seed: int
     horizon: float
     events: Tuple[FaultEvent, ...]
+    workload: Tuple[WorkloadEvent, ...] = ()
 
     @classmethod
     def generate(
@@ -173,6 +184,12 @@ class FaultSchedule:
         slow_s: float = 0.05,
         stall_s: float = 5.0,
         extra_events: Sequence["FaultEvent"] = (),
+        bursts: int = 0,
+        retry_storms: int = 0,
+        byz_floods: int = 0,
+        remixes: int = 0,
+        class_names: Sequence[str] = (),
+        workload_events: Sequence[WorkloadEvent] = (),
     ) -> "FaultSchedule":
         """Deterministic schedule over ``horizon`` seconds. Same
         arguments -> byte-identical schedule, on any host (the RNG is a
@@ -276,7 +293,50 @@ class FaultSchedule:
             events.append(FaultEvent(t=t, kind="spec_divergence"))
         events.extend(extra_events)
         events.sort(key=lambda e: (e.t, e.kind, e.target, e.spec))
-        return cls(seed=seed, horizon=horizon, events=tuple(events))
+        # workload-event draws come AFTER every fault draw so zero
+        # workload counts leave the fault RNG stream — and therefore
+        # every pre-v3 schedule — byte-identical
+        wl: List[WorkloadEvent] = []
+        honest = [c for c in class_names if c != "byzantine"]
+        for t in times(bursts):
+            target = ""
+            if honest and rng.random() < 0.5:
+                target = rng.choice(honest)
+            wl.append(WorkloadEvent(
+                t=t, kind="burst", target=target,
+                duration=rng.uniform(min(0.5, 0.15 * horizon),
+                                     0.25 * horizon),
+                magnitude=rng.uniform(2.0, 8.0),
+            ))
+        for t in times(retry_storms):
+            wl.append(WorkloadEvent(
+                t=t, kind="retry_storm",
+                duration=rng.uniform(min(0.5, 0.15 * horizon),
+                                     0.25 * horizon),
+                magnitude=rng.uniform(2.0, 4.0),
+            ))
+        for t in times(byz_floods):
+            wl.append(WorkloadEvent(
+                t=t, kind="byz_flood",
+                duration=rng.uniform(min(0.5, 0.15 * horizon),
+                                     0.25 * horizon),
+                magnitude=rng.uniform(1.0, 4.0),
+            ))
+        for t in times(remixes):
+            if len(honest) < 2:
+                continue
+            src = rng.choice(honest)
+            dst = rng.choice([c for c in honest if c != src])
+            wl.append(WorkloadEvent(
+                t=t, kind="remix", spec=f"{src}>{dst}",
+                duration=rng.uniform(min(0.5, 0.15 * horizon),
+                                     0.25 * horizon),
+                magnitude=rng.uniform(0.3, 0.9),
+            ))
+        wl.extend(workload_events)
+        wl.sort(key=lambda e: (e.t, e.kind, e.target, e.spec))
+        return cls(seed=seed, horizon=horizon, events=tuple(events),
+                   workload=tuple(wl))
 
     # --fault-schedule spec keys (regenerated into parse errors so new
     # keys can't drift undocumented): scalar keys take one value (last
@@ -303,6 +363,10 @@ class FaultSchedule:
         "drop_rate": "drop_window base rate",
         "delay_s": "delay_window base delay seconds",
         "slow_s": "slow_verifier base delay seconds",
+        "bursts": "count of burst workload events (flash crowds)",
+        "storms": "count of retry_storm workload events",
+        "floods": "count of byz_flood workload events",
+        "remixes": "count of remix workload events (class remix)",
     }
     EVENT_PARSE_KEYS: ClassVar[Dict[str, str]] = {
         "partition": (
@@ -369,6 +433,10 @@ class FaultSchedule:
             slow_s=float(scalars.get("slow_s", 0.05)),
             stall_s=float(scalars.get("stall_s", 5.0)),
             extra_events=extra,
+            bursts=int(scalars.get("bursts", 0)),
+            retry_storms=int(scalars.get("storms", 0)),
+            byz_floods=int(scalars.get("floods", 0)),
+            remixes=int(scalars.get("remixes", 0)),
         )
 
     @classmethod
@@ -422,7 +490,7 @@ class FaultSchedule:
     #: summary()/from_summary() wire format version (ISSUE 13 satellite:
     #: any failing run's exact schedule must reconstruct from its ledger
     #: line alone)
-    SUMMARY_SCHEMA: ClassVar[str] = "fault-schedule-v2"
+    SUMMARY_SCHEMA: ClassVar[str] = "fault-schedule-v3"
 
     def summary(self) -> dict:
         """Ledger/bench-record form: the complete replay tuple. Carries
@@ -435,17 +503,27 @@ class FaultSchedule:
         kinds: Dict[str, int] = {}
         for e in self.events:
             kinds[e.kind] = kinds.get(e.kind, 0) + 1
-        return {
+        doc = {
             "schema": self.SUMMARY_SCHEMA,
             "seed": self.seed,
             "horizon_s": round(self.horizon, 1),
-            # crc over the ordered kind table: replaying a ledger line
-            # under a registry that renamed/removed kinds must not
-            # silently reinterpret the schedule
+            # crc over the ordered FAULT kind table only — unchanged
+            # across v2->v3, so pre-workload ledger lines replay without
+            # a spurious registry-drift warning
             "kinds_crc": zlib.crc32(",".join(KINDS).encode()) & 0xFFFFFFFF,
             "counts": kinds,
             "events": [e.to_dict() for e in self.events],
         }
+        if self.workload:
+            wkinds: Dict[str, int] = {}
+            for e in self.workload:
+                wkinds[e.kind] = wkinds.get(e.kind, 0) + 1
+            doc["workload"] = [e.to_dict() for e in self.workload]
+            doc["workload_counts"] = wkinds
+            doc["workload_kinds_crc"] = (
+                zlib.crc32(",".join(WORKLOAD_KINDS).encode()) & 0xFFFFFFFF
+            )
+        return doc
 
     @classmethod
     def from_summary(cls, doc: dict) -> "FaultSchedule":
@@ -484,10 +562,24 @@ class FaultSchedule:
                 magnitude=float(e.get("magnitude", 0.0)),
                 spec=str(e.get("spec", "")),
             ))
+        # v2 docs carry no "workload" key: () — old ledgers still parse
+        wcrc = doc.get("workload_kinds_crc")
+        where = zlib.crc32(",".join(WORKLOAD_KINDS).encode()) & 0xFFFFFFFF
+        if wcrc is not None and int(wcrc) != where:
+            log.warning(
+                "replaying a schedule recorded under a different workload-"
+                "kind registry (crc %s, current %s): additions are fine, "
+                "semantic drift is not — review WORKLOAD_KIND_REGISTRY "
+                "history", wcrc, where,
+            )
+        workload = tuple(
+            workload_event_from_dict(e) for e in doc.get("workload", ())
+        )
         return cls(
             seed=int(doc.get("seed", 0)),
             horizon=float(doc.get("horizon_s", 0.0)),
             events=tuple(events),
+            workload=workload,
         )
 
 

@@ -267,10 +267,27 @@ class Scenario:
     probes: int = 2  # sequential post-heal liveness probes (ALL must land)
     defects: Tuple[str, ...] = ()  # planted-defect knobs (statesync.DEFECTS)
     audit_dir: Optional[str] = None  # write auditor ledgers here
+    # open-loop traffic plane (ISSUE 17): a WorkloadSpec doc — usually
+    # the compact {"preset": name, ...overrides} form. When set, the
+    # plane REPLACES the closed-loop pumps (sc.clients/requests are
+    # ignored; the committee gets spec.pool clients), workload events
+    # ride the resolved FaultSchedule (schema v3), and the SLO oracles
+    # in judge_slo() run after the safety/liveness oracles.
+    workload: Optional[Dict[str, Any]] = None
+    slo: Dict[str, Any] = field(default_factory=dict)  # judge_slo overrides
+    flight_dir: Optional[str] = None  # write per-replica flight frames here
     name: str = ""
 
     def replica_ids(self) -> Tuple[str, ...]:
         return tuple(f"r{i}" for i in range(self.n))
+
+    def workload_spec(self):
+        """Resolved WorkloadSpec, or None for closed-loop scenarios."""
+        if not self.workload:
+            return None
+        from .workload import spec_from_doc
+
+        return spec_from_doc(self.workload)
 
     def resolved_schedule(self) -> FaultSchedule:
         if self.schedule is not None:
@@ -278,9 +295,14 @@ class Scenario:
         ids = self.replica_ids()
         if self.spec:
             return FaultSchedule.parse(self.spec, self.horizon, ids)
+        gen = dict(self.gen)
+        wspec = self.workload_spec()
+        if wspec is not None and "class_names" not in gen:
+            # give generated burst/remix events real classes to target
+            gen["class_names"] = tuple(c.name for c in wspec.honest())
         return FaultSchedule.generate(
             seed=self.seed, horizon=self.horizon, replica_ids=ids,
-            **self.gen,
+            **gen,
         )
 
     def to_doc(self) -> Dict[str, Any]:
@@ -305,6 +327,8 @@ class Scenario:
             "request_timeout": self.request_timeout,
             "probes": self.probes,
             "defects": list(self.defects),
+            "workload": self.workload,
+            "slo": dict(self.slo),
             "name": self.name,
         }
 
@@ -328,6 +352,8 @@ class Scenario:
             request_timeout=float(doc.get("request_timeout", 1.0)),
             probes=int(doc.get("probes", 2)),
             defects=tuple(doc.get("defects", ())),
+            workload=doc.get("workload") or None,
+            slo=dict(doc.get("slo", {})),
             name=str(doc.get("name", "")),
         )
 
@@ -387,6 +413,24 @@ def coverage_key(cov: Dict[str, int]) -> Tuple[int, ...]:
         # rollback-during-reconfig-during-view-change interleavings
         int(cov.get("spec_executed", 0) > 0),
         bucket(int(cov.get("spec_rolled_back", 0))),
+        # traffic plane (ISSUE 17): load-shape search climbs per-class
+        # shed/latency gradients, not just protocol-state novelty. All
+        # keys absent on closed-loop runs (cov.get -> 0: legacy corpus
+        # signatures extend with zeros, they don't change meaning).
+        int(cov.get("offered", 0) > 0),
+        # total shed percent ramp (ingress + replica-plane)
+        next((i for i, edge in enumerate((0, 5, 20, 60))
+              if int(cov.get("shed_pct", 0)) <= edge), 4),
+        # worst honest-class p99 ramp (ms): the latency-tail gradient
+        next((i for i, edge in enumerate((50, 250, 1000, 4000))
+              if int(cov.get("worst_p99_ms", 0)) <= edge), 4),
+        # fairness spread: worst honest accept-ratio percent vs best —
+        # the starvation GRADIENT (the planted shed-bias defect lives
+        # at the far end)
+        next((i for i, edge in enumerate((5, 20, 50, 80))
+              if int(cov.get("fair_gap_pct", 0)) <= edge), 4),
+        bucket(int(cov.get("requeued", 0)) // 8),
+        int(cov.get("floods_sent", 0) > 0),
     )
 
 
@@ -429,20 +473,33 @@ async def _pump(client, sc: Scenario, idx: int, stats: Dict[str, int]) -> None:
 
 async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
     from .committee import LocalCommittee
+    from .consensus import replica as replica_mod
     from .consensus import speculation as speculation_mod
     from .consensus import statesync as statesync_mod
 
     t0_wall = time.monotonic()
     loop = asyncio.get_running_loop()
+    wspec = sc.workload_spec()
+    build_extra: Dict[str, Any] = {}
+    if wspec is not None and wspec.shed_watermark:
+        # scale the replica shed plane to sim scale — the production
+        # default watermark is sized for real deployments and a
+        # sim-sized committee would never reach it, leaving the
+        # overload/fairness seams unexercised
+        build_extra["shed_watermark"] = wspec.shed_watermark
     com = LocalCommittee.build(
         n=sc.n,
-        clients=sc.clients,
+        # the traffic plane multiplexes every virtual client over a
+        # BOUNDED pool of real endpoints; closed-loop scenarios keep
+        # their per-client pumps
+        clients=wspec.pool if wspec is not None else sc.clients,
         qc_mode=sc.qc_mode,
         verify_signatures=sc.verify_signatures,
         view_timeout=sc.view_timeout,
         checkpoint_interval=sc.checkpoint_interval,
         watermark_window=sc.watermark_window,
         speculative=sc.speculative,
+        **build_extra,
     )
 
     def _tap(src: str, dst: str, kind: str, nbytes: int, verdict: str) -> None:
@@ -460,21 +517,48 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
     # list feeds them all (unknown names are simply inert in each)
     prev_spec_defects = set(speculation_mod.DEFECTS)
     speculation_mod.DEFECTS |= set(sc.defects)
+    prev_replica_defects = set(replica_mod.DEFECTS)
+    replica_mod.DEFECTS |= set(sc.defects)
     schedule = sc.resolved_schedule()
     injector = FaultInjector(committee=com, schedule=schedule)
     failure: Optional[str] = None
     pump_stats: Dict[str, int] = {"accepted": 0, "timeouts": 0, "errors": 0}
+    plane = None
+    flight_recorders: List[Any] = []
+    if wspec is not None:
+        from .workload import TrafficPlane
+
+        plane = TrafficPlane(
+            com, wspec, schedule.workload, sc.seed, sc.horizon,
+            # per-window load notes ride the trace, so the run
+            # fingerprint covers the traffic timeline too
+            note=lambda **kv: trace.note("load", **kv),
+        )
+        com.traffic_stats = plane.stats
     try:
         com.start()
         for c in com.clients:
             c.request_timeout = sc.request_timeout
+        if sc.flight_dir:
+            from .telemetry import FlightRecorder
+
+            for r in com.replicas:
+                fr = FlightRecorder(
+                    com.node_telemetry(r.id),
+                    f"{sc.flight_dir}/flight_{r.id}.jsonl",
+                )
+                fr.start()
+                flight_recorders.append(fr)
         inj_task = loop.create_task(
             injector.run(stop_at=clock_mod.now() + sc.horizon)
         )
-        pumps = [
-            loop.create_task(_pump(c, sc, i, pump_stats))
-            for i, c in enumerate(com.clients)
-        ]
+        if plane is not None:
+            pumps = [loop.create_task(plane.run())]
+        else:
+            pumps = [
+                loop.create_task(_pump(c, sc, i, pump_stats))
+                for i, c in enumerate(com.clients)
+            ]
         await clock_mod.sleep(sc.horizon)
         injector.stop()
         await asyncio.gather(inj_task, return_exceptions=True)
@@ -486,6 +570,13 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
             p.cancel()
         if pending:
             await asyncio.gather(*pending, return_exceptions=True)
+        if plane is not None:
+            # settle the plane's in-flight submissions (whatever
+            # outlives the budget is counted abandoned, never lost)
+            await plane.drain(sc.drain)
+            pump_stats["accepted"] += sum(plane.stats.accepted.values())
+            pump_stats["timeouts"] += sum(plane.stats.timeouts.values())
+            pump_stats["errors"] += sum(plane.stats.errors.values())
         # liveness probes: with every network fault healed, a SEQUENCE
         # of fresh requests must commit within the (virtual) probe
         # patience each. A sequence, not one: several wedge shapes (a
@@ -511,12 +602,22 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
         trace.note("probes", ok=probes_ok, want=sc.probes)
         pump_stats["probes_ok"] = probes_ok
         pump_stats["probe_s"] = int(clock_mod.now() - t_probe0)
+        for fr in flight_recorders:
+            await fr.stop()
+        flight_recorders = []
         await com.stop()
     finally:
         statesync_mod.DEFECTS.clear()
         statesync_mod.DEFECTS |= prev_defects
         speculation_mod.DEFECTS.clear()
         speculation_mod.DEFECTS |= prev_spec_defects
+        replica_mod.DEFECTS.clear()
+        replica_mod.DEFECTS |= prev_replica_defects
+        for fr in flight_recorders:  # failure path: stop what's left
+            try:
+                await fr.stop()
+            except Exception:
+                pass
         for a in auditors.values():
             a.close()
 
@@ -578,6 +679,18 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
     honest_accused = sorted(accused_union - set(byz))
     if honest_accused and failure is None:
         failure = f"safety:honest-accused:{','.join(honest_accused)}"
+    # SLO oracles (ISSUE 17): judged AFTER safety/liveness so a genuine
+    # protocol failure keeps its (more actionable) failure class;
+    # verdicts ride details.slo either way
+    slo_verdicts: Dict[str, Any] = {}
+    if plane is not None and wspec is not None:
+        from .workload import judge_slo
+
+        slo_verdicts, slo_failure = judge_slo(
+            plane.stats, wspec, sc.slo or None
+        )
+        if slo_failure is not None and failure is None:
+            failure = slo_failure
     app_digests = {}
     for r in honest:
         snap = r.app.snapshot()
@@ -635,6 +748,34 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
             r.metrics.get("spec_rolled_back", 0) for r in com.replicas
         ),
     }
+    if plane is not None:
+        # traffic-plane coverage (ISSUE 17): the per-class shed/latency
+        # gradients load-shape search climbs. Closed-loop runs carry
+        # none of these keys (coverage_key reads them via cov.get).
+        stats = plane.stats
+        t = stats.totals()
+        replica_shed = sum(
+            r.metrics.get("messages_shed", 0) for r in com.replicas
+        )
+        honest_ratios = [
+            stats.accept_ratio(n) for n in stats.class_names
+            if n not in stats.byz_names and stats.offered[n] >= 50
+        ]
+        cov.update({
+            "offered": t["offered"],
+            "ingress_shed": t["shed"],
+            "replica_shed": replica_shed,
+            "shed_pct": int(
+                100 * (t["shed"] + replica_shed) / max(1, t["offered"])
+            ),
+            "worst_p99_ms": int(stats.worst_honest_p99_ms()),
+            "fair_gap_pct": int(
+                100 * (max(honest_ratios) - min(honest_ratios))
+            ) if honest_ratios else 0,
+            "requeued": t["requeued"],
+            "floods_sent": t["floods_sent"],
+            "clients_touched": t["clients"],
+        })
     # fold the consensus outcome into the trace so the fingerprint
     # covers protocol RESULTS, not just wire traffic
     for r in sorted(honest, key=lambda x: x.id):
@@ -643,6 +784,17 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
             stable=r.stable_seq, app=app_digests[r.id],
         )
 
+    details: Dict[str, Any] = {
+        "pump": dict(pump_stats), "trace_lines": len(trace.lines),
+    }
+    if plane is not None:
+        details["traffic"] = plane.stats.snapshot_block()
+        # flat block for bench ledger lines (workload.bench_record /
+        # tools/traffic_smoke.py — the run itself stays ledger-agnostic)
+        details["traffic_bench"] = plane.stats.bench_traffic_block(
+            sc.horizon
+        )
+        details["slo"] = slo_verdicts
     return SimResult(
         ok=failure is None,
         failure=failure,
@@ -654,7 +806,7 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
         schedule=schedule.summary(),
         byzantine=byz,
         app_digests=app_digests,
-        details={"pump": dict(pump_stats), "trace_lines": len(trace.lines)},
+        details=details,
     )
 
 
@@ -699,11 +851,13 @@ def minimize(
     max_runs: int = 160,
     progress: Optional[Callable[[str], None]] = None,
 ) -> Tuple[Scenario, SimResult, int]:
-    """ddmin over the failing scenario's event list: find a (locally)
-    minimal subset of fault events that still produces the SAME failure
-    class, each probe being one full deterministic re-run. Returns the
-    minimized scenario (explicit schedule), its result, and how many
-    runs the search spent."""
+    """ddmin over the failing scenario's event list — fault AND workload
+    events as one tagged pool (since schema v3 a repro's load shape is
+    part of the replay tuple, and a flash crowd can be as load-bearing
+    as a crash): find a (locally) minimal subset that still produces the
+    SAME failure class, each probe being one full deterministic re-run.
+    Returns the minimized scenario (explicit schedule), its result, and
+    how many runs the search spent."""
     base_sched = sc.resolved_schedule()
     baseline = run_scenario(replace(sc, schedule=base_sched))
     if baseline.failure is None:
@@ -711,58 +865,54 @@ def minimize(
     target = baseline.failure_class
     runs = 1
 
-    def fails(events: Tuple) -> bool:
+    def _sched(items: List[Tuple[str, Any]]) -> FaultSchedule:
+        return FaultSchedule(
+            seed=base_sched.seed,
+            horizon=base_sched.horizon,
+            events=tuple(e for tag, e in items if tag == "f"),
+            workload=tuple(e for tag, e in items if tag == "w"),
+        )
+
+    def fails(items: List[Tuple[str, Any]]) -> bool:
         nonlocal runs
         if runs >= max_runs:
             return False
         runs += 1
-        cand = replace(
-            sc,
-            schedule=FaultSchedule(
-                seed=base_sched.seed,
-                horizon=base_sched.horizon,
-                events=tuple(events),
-            ),
-        )
-        res = run_scenario(cand)
+        res = run_scenario(replace(sc, schedule=_sched(items)))
         return res.failure_class == target
 
-    events = list(base_sched.events)
+    items: List[Tuple[str, Any]] = (
+        [("f", e) for e in base_sched.events]
+        + [("w", e) for e in base_sched.workload]
+    )
     granularity = 2
-    while len(events) >= 2 and runs < max_runs:
-        chunk = max(1, len(events) // granularity)
+    while len(items) >= 2 and runs < max_runs:
+        chunk = max(1, len(items) // granularity)
         shrunk = False
         i = 0
-        while i < len(events):
-            cand = events[:i] + events[i + chunk:]
-            if cand and fails(tuple(cand)):
-                events = cand
+        while i < len(items):
+            cand = items[:i] + items[i + chunk:]
+            if cand and fails(cand):
+                items = cand
                 granularity = max(2, granularity - 1)
                 shrunk = True
                 if progress:
-                    progress(f"shrunk to {len(events)} events ({runs} runs)")
+                    progress(f"shrunk to {len(items)} events ({runs} runs)")
             else:
                 i += chunk
         if not shrunk:
-            if granularity >= len(events):
+            if granularity >= len(items):
                 break
-            granularity = min(len(events), granularity * 2)
+            granularity = min(len(items), granularity * 2)
     # final greedy pass: drop single events
     i = 0
-    while i < len(events) and len(events) > 1 and runs < max_runs:
-        cand = events[:i] + events[i + 1:]
-        if fails(tuple(cand)):
-            events = cand
+    while i < len(items) and len(items) > 1 and runs < max_runs:
+        cand = items[:i] + items[i + 1:]
+        if fails(cand):
+            items = cand
         else:
             i += 1
-    final = replace(
-        sc,
-        schedule=FaultSchedule(
-            seed=base_sched.seed,
-            horizon=base_sched.horizon,
-            events=tuple(events),
-        ),
-    )
+    final = replace(sc, schedule=_sched(items))
     return final, run_scenario(final), runs
 
 

@@ -338,6 +338,7 @@ class NodeTelemetry:
         client=None,
         tracer: Optional["RequestTracer"] = None,
         loop_lag: Optional["LoopLagGauge"] = None,
+        traffic=None,
     ) -> None:
         self.node_id = node_id
         self.replica = replica
@@ -345,6 +346,10 @@ class NodeTelemetry:
         self.client = client
         self.tracer = tracer
         self.loop_lag = loop_lag
+        # workload.TrafficStats (ISSUE 17): the open-loop traffic
+        # plane's per-class offered/accepted/shed/latency accounting —
+        # plane-wide, reported identically by every in-process node
+        self.traffic = traffic
         self._t0 = clock.now()
 
     def snapshot(self) -> Dict[str, Any]:
@@ -380,6 +385,12 @@ class NodeTelemetry:
             # event-loop scheduling delay (ISSUE 4): a starved dispatcher
             # core shows here before it shows anywhere else
             snap["loop_lag"] = self.loop_lag.snapshot()
+        if self.traffic is not None:
+            # traffic observatory (ISSUE 17): per-class offered vs
+            # accepted req/s, shed counts, windowed latency percentiles
+            # — pbft_top's LOAD column and tools/traffic_report.py read
+            # this (additive key: SCHEMA_VERSION unchanged)
+            snap["traffic"] = self.traffic.snapshot_block()
         if self.tracer is not None:
             snap["tracer"] = {
                 "sample_mod": self.tracer.sample_mod,

@@ -401,3 +401,52 @@ def test_explorer_mutations_stay_in_registry():
         sched = sim_explore.mutate(rng, sched, ids)
         FaultSchedule.from_summary(sched.summary())  # must not raise
     assert all(0 <= e.t <= 0.9 * 30.0 for e in sched.events)
+
+
+def test_explorer_workload_mutations_stay_in_registry():
+    """ISSUE 17: the load-shape operators (w_burst/w_flood/w_storm/
+    w_remix/w_shift/w_scale/w_drop) obey the same closure — every
+    mutant's summary replays as fault-schedule-v3, workload kinds stay
+    inside the WorkloadEvent registry, and times stay in-horizon."""
+    import random
+
+    from simple_pbft_tpu.workload import WORKLOAD_KINDS
+    from tools import sim_explore
+
+    rng = random.Random(5)
+    ids = ("r0", "r1", "r2", "r3")
+    sched = FaultSchedule.generate(
+        seed=2, horizon=30.0, crashes=1, replica_ids=ids,
+        bursts=1, class_names=("interactive", "bulk"),
+    )
+    saw_workload = False
+    for _ in range(80):
+        sched = sim_explore.mutate(rng, sched, ids, workload=True,
+                                   wclasses=("interactive", "bulk"))
+        rt = FaultSchedule.from_summary(sched.summary())
+        assert rt.summary() == sched.summary()  # fixed point
+        saw_workload = saw_workload or bool(sched.workload)
+    assert saw_workload  # the operators actually fired
+    assert all(e.kind in WORKLOAD_KINDS for e in sched.workload)
+    assert all(0 <= e.t <= 0.9 * 30.0 for e in sched.workload)
+
+
+@pytest.mark.slow
+def test_overload_starvation_repro():
+    """ISSUE 17, both ways: the load-shape search (sim_explore --mode
+    search --workload overload) found the planted shed_bulk_bias
+    defect's fairness hole — size-biased overload shedding starves the
+    interactive class — and ddmin minimized the shape to a single
+    demand burst with zero fault events. Armed, the starvation SLO
+    oracle fails the run; on fixed code the same shape passes clean."""
+    doc = load_repro("overload_starvation.json")
+    sc = scenario_from_artifact(doc)
+    assert "shed_bulk_bias" in sc.defects  # recorded as found
+    assert sc.workload  # the repro carries its load shape
+    starved = run_scenario(sc)
+    assert not starved.ok
+    assert starved.failure.startswith("slo:starved-class"), starved.failure
+    fixed = run_scenario(replace(sc, defects=()))
+    assert fixed.ok, fixed.failure
+    # and the clean run still genuinely overloads (non-trivial TN)
+    assert fixed.details["traffic"]["shed"] > 0

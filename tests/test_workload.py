@@ -1,0 +1,324 @@
+"""Traffic observatory (ISSUE 17): open-loop arrival determinism, SLO
+oracle true-positive/true-negative behavior, bounded memory at 10^5+
+virtual clients, flight-frame stitching, and the schedule schema that
+carries load shapes.
+
+Everything scenario-shaped runs in VIRTUAL time (sim.run_scenario): the
+arrival stream, the shed decisions, and the latency windows are a pure
+function of the seed."""
+
+import json
+import tracemalloc
+from dataclasses import replace
+
+import pytest
+
+from simple_pbft_tpu.faults import FaultSchedule
+from simple_pbft_tpu.sim import Scenario, run_scenario
+from simple_pbft_tpu.workload import (
+    DEFAULT_SLO,
+    PRESETS,
+    TrafficStats,
+    WorkloadEvent,
+    arrival_digest,
+    judge_slo,
+    preset,
+    spec_from_doc,
+)
+
+
+# ---------------------------------------------------------------------------
+# deterministic arrivals
+# ---------------------------------------------------------------------------
+
+
+def test_arrival_stream_is_seed_deterministic():
+    """Same (spec, events, seed) => byte-identical planned arrival
+    stream, including client identities, flood counts, and the ingress
+    shed accounting; a different seed diverges."""
+    spec = preset("overload")
+    events = (
+        WorkloadEvent(t=2.0, kind="burst", duration=1.5, magnitude=4.0),
+        WorkloadEvent(t=5.0, kind="retry_storm", duration=2.0,
+                      magnitude=3.0),
+        WorkloadEvent(t=3.0, kind="remix", duration=2.0, magnitude=0.5,
+                      spec="interactive>bulk"),
+    )
+    d1 = arrival_digest(spec, events, seed=11, horizon=8.0)
+    d2 = arrival_digest(spec, events, seed=11, horizon=8.0)
+    d3 = arrival_digest(spec, events, seed=12, horizon=8.0)
+    assert d1 == d2
+    assert d1 != d3
+
+
+def test_workload_run_fingerprint_deterministic():
+    """The full sim (plane + committee + oracles) replays byte for
+    byte: same seed => same trace fingerprint and same traffic totals."""
+    sc = Scenario(seed=7, horizon=4.0, workload={"preset": "steady"})
+    r1, r2 = run_scenario(sc), run_scenario(sc)
+    assert r1.fingerprint == r2.fingerprint
+    assert r1.details["traffic"]["offered"] == r2.details["traffic"]["offered"]
+    assert r1.details["traffic"]["accepted"] == r2.details["traffic"]["accepted"]
+    assert r1.coverage["clients_touched"] > 0
+
+
+# ---------------------------------------------------------------------------
+# SLO oracles: true negatives (healthy committees pass under any shape)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_slo_clean_overload_passes():
+    """3x overcommit with fair shedding: the starvation oracle must NOT
+    fire — fair arrival-order shedding equalizes per-window accept
+    ratios, which is exactly what it checks. (Tier-1 runs the same TN
+    through tools/traffic_smoke.py's smoke gate; this stays slow-tier.)"""
+    res = run_scenario(Scenario(seed=3, workload={"preset": "overload"}))
+    assert res.ok, res.failure
+    sv = res.details["slo"]["starvation"]
+    assert sv["ok"] and not sv["starved_windows"]
+    # and the run genuinely overloaded (this is not a trivially idle TN)
+    assert res.details["traffic"]["shed"] > 0
+
+
+def test_slo_oracles_judged_on_steady():
+    res = run_scenario(Scenario(seed=3, workload={"preset": "steady"}))
+    assert res.ok, res.failure
+    slo = res.details["slo"]
+    assert set(slo) >= {"p99", "starvation", "shed_before_collapse"}
+    for n in ("interactive", "bulk"):
+        assert slo["p99"][n]["ok"]
+
+
+# ---------------------------------------------------------------------------
+# SLO oracles: true positives (each family can actually fire)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_slo_starvation_fires_on_planted_defect():
+    """overload + shed_bulk_bias: size-biased shedding starves the
+    interactive class in every loaded window. (Tier-1 runs the same TP
+    through tools/traffic_smoke.py's canary gate; this stays slow-tier.)"""
+    res = run_scenario(Scenario(
+        seed=3, workload={"preset": "overload"},
+        defects=("shed_bulk_bias",),
+    ))
+    assert res.failure == "slo:starved-class:interactive"
+    sv = res.details["slo"]["starvation"]
+    assert sv["starved_windows"]["interactive"] >= DEFAULT_SLO["starve_windows"]
+
+
+def _synthetic_stats(spec):
+    stats = TrafficStats(spec)
+
+    class _FakePlan:
+        def __init__(self, index, offered, shed):
+            self.index = index
+            self.t0 = index * spec.window
+            self.offered = offered
+            self.shed_ingress = shed
+
+    return stats, _FakePlan
+
+
+def test_slo_p99_fires_on_slow_accepts():
+    spec = preset("steady")
+    stats, _ = _synthetic_stats(spec)
+    bound_s = (2.0 * spec.patience + 10.0)  # the derived default, in s
+    for _ in range(30):
+        stats.complete("interactive", "accepted", latency=bound_s * 2)
+    verdicts, failure = judge_slo(stats, spec)
+    assert failure == "slo:p99:interactive"
+    assert not verdicts["p99"]["interactive"]["ok"]
+
+
+def test_slo_collapse_fires_on_silent_queueing():
+    """Windows that push wire traffic but neither complete nor shed are
+    the silent-queuing shape: past collapse_windows consecutive ones
+    the run fails even though no safety oracle tripped."""
+    spec = preset("steady")
+    stats, FakePlan = _synthetic_stats(spec)
+    blind = int(DEFAULT_SLO["collapse_windows"]) + 1
+    for w in range(blind):
+        stats.close_window(
+            FakePlan(w, {"interactive": 40, "bulk": 10}, {}),
+            {"interactive": 30, "bulk": 8},
+        )
+    verdicts, failure = judge_slo(stats, spec)
+    assert failure == "slo:collapse"
+    assert verdicts["shed_before_collapse"]["longest_blind_run"] >= blind
+
+
+def test_slo_starvation_synthetic_needs_persistence():
+    """One starved window is attribution noise; starve_windows of them
+    is a verdict — the persistence threshold is what makes the oracle
+    sound under retry-landing skew."""
+    spec = preset("steady")
+    need = int(DEFAULT_SLO["starve_windows"])
+
+    def run_windows(n_starved):
+        stats, FakePlan = _synthetic_stats(spec)
+        for w in range(n_starved):
+            stats._win_acc = {"interactive": 1, "bulk": 30, "byzantine": 0}
+            stats.close_window(
+                FakePlan(w, {"interactive": 60, "bulk": 60}, {}),
+                {"interactive": 60, "bulk": 60},
+            )
+        return judge_slo(stats, spec)[1]
+
+    assert run_windows(need - 1) is None
+    assert run_windows(need) == "slo:starved-class:interactive"
+
+
+# ---------------------------------------------------------------------------
+# scale: 10^5 clients, bounded memory
+# ---------------------------------------------------------------------------
+
+
+def test_1e5_clients_bounded_memory():
+    """Planning 10^5+ virtual clients' arrivals must stay O(classes +
+    wire budget): identity is a rotating pointer, never a per-client
+    object. 60 windows of smoke1e5 touch the full 110k population in a
+    few MB."""
+    spec = preset("smoke1e5")
+    assert spec.population() >= 100_000
+    from simple_pbft_tpu.workload import ArrivalGen
+
+    tracemalloc.start()
+    gen = ArrivalGen(spec, (), seed=5)
+    for w in range(60):  # 30 s of 0.5 s windows
+        gen.plan(w)
+    touched = sum(gen.clients_touched().values())
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert touched >= 100_000
+    assert peak < 8 * 1024 * 1024, f"peak {peak} bytes for 1e5 clients"
+
+
+@pytest.mark.slow
+def test_million_clients_open_loop_acceptance():
+    """ISSUE 17 acceptance: one sim run drives >= 10^6 distinct virtual
+    clients open-loop within the tier-2 wall budget, with per-class SLO
+    verdicts on the result."""
+    sc = Scenario(
+        seed=3, horizon=360.0, workload={"preset": "million"},
+        name="million_acceptance",
+    )
+    res = run_scenario(sc, wall_timeout=900.0)
+    assert res.ok, res.failure
+    assert res.coverage["clients_touched"] >= 1_000_000
+    slo = res.details["slo"]
+    for n in ("interactive", "bulk"):
+        assert n in slo["p99"]
+    assert slo["starvation"]["ok"]
+
+
+# ---------------------------------------------------------------------------
+# flight frames -> traffic_report
+# ---------------------------------------------------------------------------
+
+
+def test_traffic_report_stitches_flight_frames(tmp_path):
+    sc = Scenario(seed=3, horizon=6.0, workload={"preset": "steady"},
+                  flight_dir=str(tmp_path))
+    res = run_scenario(sc)
+    assert res.ok, res.failure
+    from tools import traffic_report
+
+    paths = sorted(str(p) for p in tmp_path.glob("flight_*.jsonl"))
+    assert paths
+    frames = traffic_report.load_frames(paths)
+    windows = traffic_report.stitch_windows(frames)
+    # the union across overlapping tails reconstructs EVERY window
+    assert [w["w"] for w in windows] == list(range(len(windows)))
+    assert len(windows) >= 10
+    classes = traffic_report.totals_by_class(windows, frames)
+    assert classes["interactive"]["acc"] > 0
+    # rendering is exercised too (no live terminal needed)
+    out = traffic_report.render(
+        windows, traffic_report.commit_series(frames), classes
+    )
+    assert "totals:" in out and "interactive" in out
+
+
+# ---------------------------------------------------------------------------
+# schedule schema v3 (workload events ride FaultSchedule summaries)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_schedule_v3_roundtrip_with_workload():
+    sched = FaultSchedule.generate(
+        seed=9, horizon=20.0, replica_ids=("r0", "r1", "r2", "r3"),
+        crashes=1, bursts=2, retry_storms=1, byz_floods=1, remixes=1,
+        class_names=("interactive", "bulk"),
+    )
+    assert sched.workload  # the draws actually happened
+    d = sched.summary()
+    assert d["schema"] == "fault-schedule-v3"
+    assert d["workload_counts"]["burst"] == 2
+    r = FaultSchedule.from_summary(d)
+    assert r.summary() == d  # fixed point (the repo's replay contract)
+
+
+def test_fault_schedule_v2_docs_still_parse():
+    """A pre-ISSUE-17 summary (no workload keys) must load with an
+    empty workload tuple and no crc warning."""
+    sched = FaultSchedule.generate(
+        seed=9, horizon=20.0, replica_ids=("r0", "r1"), crashes=1,
+    )
+    d = sched.summary()
+    assert "workload" not in d  # fault-only summaries stay v2-shaped
+    v2 = dict(d)
+    v2["schema"] = "fault-schedule-v2"
+    r = FaultSchedule.from_summary(v2)
+    assert r.workload == ()
+    # summary() rounds event times; compare at its precision
+    assert tuple(e.t for e in r.events) == tuple(
+        round(e.t, 3) for e in sched.events)
+
+
+def test_zero_workload_draws_leave_fault_stream_identical():
+    """Workload draws happen AFTER every fault draw, so arming the
+    kwargs with zero counts is byte-invisible to the fault stream —
+    pre-ISSUE-17 seeds replay unchanged."""
+    kw = dict(seed=4, horizon=15.0, replica_ids=("r0", "r1", "r2"),
+              crashes=1, partition_windows=2)
+    a = FaultSchedule.generate(**kw)
+    b = FaultSchedule.generate(
+        **kw, bursts=0, retry_storms=0, byz_floods=0, remixes=0,
+        class_names=("interactive", "bulk"),
+    )
+    assert a.events == b.events
+    assert b.workload == ()
+
+
+# ---------------------------------------------------------------------------
+# presets / spec docs
+# ---------------------------------------------------------------------------
+
+
+def test_preset_doc_roundtrip_with_overrides():
+    spec = spec_from_doc({"preset": "overload", "shed_watermark": 12})
+    assert spec.shed_watermark == 12
+    base = preset("overload")
+    assert [c.name for c in spec.classes] == [c.name for c in base.classes]
+    # every preset materializes and carries at least one honest class
+    for name in PRESETS:
+        p = preset(name)
+        assert p.honest(), name
+        assert p.population() > 0
+
+
+def test_workload_scenario_doc_roundtrip():
+    """Scenario.workload rides artifact docs verbatim (the repro path:
+    scenario_from_artifact must rebuild the same plane)."""
+    from simple_pbft_tpu.sim import artifact_doc, scenario_from_artifact
+
+    sc = Scenario(seed=5, horizon=4.0,
+                  workload={"preset": "steady", "pool": 2})
+    res = run_scenario(sc)
+    doc = artifact_doc(sc, res)
+    sc2 = scenario_from_artifact(doc)
+    assert sc2.workload == sc.workload
+    assert run_scenario(sc2).fingerprint == res.fingerprint

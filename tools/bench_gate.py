@@ -85,6 +85,19 @@ METRICS: Dict[str, Tuple[int, float]] = {
     "device.verifies_per_s_effective": (+1, 0.40),
     "device.occupancy": (+1, 0.50),
     "device.pad_waste_pct": (-1, 0.50),
+    # traffic observatory (ISSUE 17): per-class admission quality under
+    # open-loop load. Virtual-time runs are deterministic, so the
+    # floors guard real admission-path changes, not host noise — but CI
+    # still pins these via gate.min floors (traffic_ci_reference.jsonl)
+    # because accepted counts shift legitimately when shed-plane
+    # defaults are retuned. shed_fraction and the per-class p99s
+    # regress UP; accepted rate and the interactive accept ratio
+    # regress DOWN.
+    "traffic.accepted_req_s": (+1, 0.25),
+    "traffic.interactive_p99_ms": (-1, 0.50),
+    "traffic.bulk_p99_ms": (-1, 0.50),
+    "traffic.shed_fraction": (-1, 0.25),
+    "traffic.interactive_accept_ratio": (+1, 0.25),
 }
 
 MAD_Z = 4.0  # tolerance = MAD_Z sigma-equivalents of the reference spread

@@ -36,7 +36,7 @@ from typing import Dict, List, Optional, Tuple
 COLUMNS = (
     "NODE", "SRC", "VIEW", "ROLE", "EXEC", "STABLE", "CAGE", "BACKLOG",
     "VQ", "QCQ", "QCB", "PAIRms", "SHED", "DEG", "QUAR", "REJ", "WDOG",
-    "AUD", "SPEC", "NET", "NETIO", "DEV", "RTTms", "LAGms", "REQ/s",
+    "AUD", "SPEC", "LOAD", "NET", "NETIO", "DEV", "RTTms", "LAGms", "REQ/s",
 )
 
 
@@ -109,6 +109,35 @@ def spec_cell(snap: dict) -> str:
     return cell
 
 
+def load_cell(snap: dict, prev: Optional[dict], dt: float) -> str:
+    """LOAD: traffic-observatory posture (ISSUE 17) —
+    ``offered>accepted/s shed% p99ms`` where the rates are per-class-
+    summed offered vs accepted req/s between refreshes in the live
+    loop (falling back to the frame's last-closed-window rates on the
+    first frame / a flight tail), shed% is the cumulative shed fraction
+    of offered, and p99 is the worst honest class's run p99. Blank when
+    the node carries no traffic block (not a workload run). Offered
+    climbing while accepted holds flat IS overload working as designed;
+    shed% ~0 while accepted collapses is the silent-queuing shape the
+    shed-before-collapse oracle rejects (docs/SCENARIOS.md)."""
+    tr = snap.get("traffic") or {}
+    if not tr:
+        return ""
+    off, acc = tr.get("offered", 0), tr.get("accepted", 0)
+    ptr = (prev or {}).get("traffic") or {}
+    if ptr and dt > 0 and off >= ptr.get("offered", 0):
+        d_off = (off - ptr.get("offered", 0)) / dt
+        d_acc = (acc - ptr.get("accepted", 0)) / dt
+    else:
+        d_off = tr.get("offered_req_s", 0.0)
+        d_acc = tr.get("accepted_req_s", 0.0)
+    shed_pct = 100.0 * tr.get("shed", 0) / off if off else 0.0
+    return (
+        f"{_fmt_rate(d_off)}>{_fmt_rate(d_acc)}/s "
+        f"{shed_pct:.0f}% {tr.get('worst_p99_ms', 0.0):.0f}ms"
+    )
+
+
 def net_cell(snap: dict) -> str:
     """NET: per-node partition/shaping state (ISSUE 7). Composed from the
     transport block's ``shaping`` sub-snapshot (faults.ShapedTransport):
@@ -177,6 +206,12 @@ def discover(log_dir: str) -> Tuple[List[str], Dict[str, str], Dict[str, str]]:
         os.path.basename(p)[: -len(".flight.jsonl")]: p
         for p in sorted(glob.glob(os.path.join(log_dir, "*.flight.jsonl")))
     }
+    # sim flight frames (Scenario.flight_dir) use the flight_<node>.jsonl
+    # spelling; fold them in under the node name so the post-mortem
+    # table reads a sim run's last posture too (ISSUE 17)
+    for p in sorted(glob.glob(os.path.join(log_dir, "flight_*.jsonl"))):
+        node = os.path.basename(p)[len("flight_"):-len(".jsonl")]
+        flights.setdefault(node, p)
     evidence = {
         os.path.basename(p)[: -len(".evidence.jsonl")]: p
         for p in sorted(glob.glob(os.path.join(log_dir, "*.evidence.jsonl")))
@@ -282,6 +317,7 @@ def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
         str(ver.get("watchdog_failovers", "")),
         aud_cell,
         spec_cell(snap),
+        load_cell(snap, prev, dt),
         net_cell(snap),
         netio_cell(snap, prev, dt),
         dev_cell(snap),

@@ -55,6 +55,7 @@ if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
 from simple_pbft_tpu.faults import FaultEvent, FaultSchedule  # noqa: E402
+from simple_pbft_tpu.workload import PRESETS, WorkloadEvent  # noqa: E402
 from simple_pbft_tpu.sim import (  # noqa: E402
     Scenario,
     SimResult,
@@ -84,11 +85,18 @@ def base_scenario(args, seed: int) -> Scenario:
         verify_signatures=args.signed,
         qc_mode=args.qc,
         defects=tuple(args.defect or ()),
+        # open-loop traffic plane (ISSUE 17): the named preset replaces
+        # the closed-loop pumps and arms the SLO oracles
+        workload=(
+            {"preset": args.workload}
+            if getattr(args, "workload", None) else None
+        ),
     )
 
 
 def sample_gen(
-    rng: random.Random, signed: bool, qc: bool = False
+    rng: random.Random, signed: bool, qc: bool = False,
+    workload: bool = False,
 ) -> Dict[str, object]:
     """Random generate() kwargs for a fresh corpus seed: light faulting,
     weighted toward the network kinds the search mutates well."""
@@ -104,6 +112,13 @@ def sample_gen(
         # ISSUE 15: the speculative-divergence primary (QC-mode seam) —
         # prepared-slot withholding whose fork surfaces at view change
         gen["spec_divergers"] = 1
+    if workload:
+        # load-shape counts draw LAST (and only in workload families):
+        # fault-only invocations keep byte-identical RNG streams
+        gen["bursts"] = rng.choice((0, 1, 1, 2))
+        gen["retry_storms"] = rng.choice((0, 0, 1))
+        gen["byz_floods"] = rng.choice((0, 0, 1))
+        gen["remixes"] = rng.choice((0, 0, 1))
     return gen
 
 
@@ -126,19 +141,100 @@ def _rand_groups(rng: random.Random, ids: Tuple[str, ...]) -> str:
     return f"{a}{arrow}{b}"
 
 
+W_OPS = ("w_burst", "w_flood", "w_storm", "w_remix",
+         "w_shift", "w_scale", "w_drop")
+
+
 def mutate(
-    rng: random.Random, sched: FaultSchedule, ids: Tuple[str, ...]
+    rng: random.Random, sched: FaultSchedule, ids: Tuple[str, ...],
+    workload: bool = False,
+    wclasses: Tuple[str, ...] = ("interactive", "bulk"),
 ) -> FaultSchedule:
     """One mutation step over the event list. Times/durations stay
     inside the horizon; durations may grow LONG (up to 0.85h) — rare
-    wedges live behind windows the generator's 0.15h cap never deals."""
+    wedges live behind windows the generator's 0.15h cap never deals.
+
+    With ``workload=True`` (ISSUE 17) the operator set also covers the
+    load-shape plane: insert/shift/scale/drop bursts, retry storms,
+    byzantine floods, and class remixes over ``wclasses`` — the search
+    can steer offered load the same way it steers faults."""
     h = sched.horizon
     events: List[FaultEvent] = list(sched.events)
+    wl: List[WorkloadEvent] = list(sched.workload)
+
+    def done() -> FaultSchedule:
+        events.sort(key=lambda ev: (ev.t, ev.kind, ev.target, ev.spec))
+        wl.sort(key=lambda ev: (ev.t, ev.kind, ev.target, ev.spec))
+        return FaultSchedule(seed=sched.seed, horizon=h,
+                             events=tuple(events), workload=tuple(wl))
+
     ops = ["add_partition", "add_crash", "shift", "drop", "extend",
            "retime_dup", "flip_chain", "add_divergence"]
     if not events:
         ops = ["add_partition", "add_crash"]
+    if workload:
+        ops += list(W_OPS)
     op = rng.choice(ops)
+    if op in ("w_shift", "w_scale", "w_drop") and not wl:
+        op = "w_burst"
+    if op == "w_remix" and len(wclasses) < 2:
+        op = "w_burst"
+    if op == "w_burst":
+        wl.append(WorkloadEvent(
+            t=round(rng.uniform(0.03 * h, 0.7 * h), 3),
+            kind="burst",
+            target=rng.choice(("", *wclasses)),
+            duration=round(rng.uniform(min(0.5, 0.15 * h), 0.25 * h), 3),
+            magnitude=round(rng.uniform(2.0, 8.0), 4),
+        ))
+        return done()
+    if op == "w_storm":
+        wl.append(WorkloadEvent(
+            t=round(rng.uniform(0.03 * h, 0.7 * h), 3),
+            kind="retry_storm",
+            duration=round(rng.uniform(min(0.5, 0.15 * h), 0.25 * h), 3),
+            magnitude=round(rng.uniform(2.0, 4.0), 4),
+        ))
+        return done()
+    if op == "w_flood":
+        wl.append(WorkloadEvent(
+            t=round(rng.uniform(0.03 * h, 0.7 * h), 3),
+            kind="byz_flood",
+            duration=round(rng.uniform(min(0.5, 0.15 * h), 0.25 * h), 3),
+            magnitude=round(rng.uniform(1.0, 4.0), 4),
+        ))
+        return done()
+    if op == "w_remix":
+        src, dst = rng.sample(list(wclasses), 2)
+        wl.append(WorkloadEvent(
+            t=round(rng.uniform(0.03 * h, 0.7 * h), 3),
+            kind="remix",
+            duration=round(rng.uniform(min(0.5, 0.15 * h), 0.25 * h), 3),
+            magnitude=round(rng.uniform(0.3, 0.9), 4),
+            spec=f"{src}>{dst}",
+        ))
+        return done()
+    if op == "w_shift":
+        i = rng.randrange(len(wl))
+        e = wl[i]
+        wl[i] = WorkloadEvent(
+            t=round(min(0.9 * h, max(0.0, e.t + rng.uniform(-0.2 * h, 0.2 * h))), 3),
+            kind=e.kind, target=e.target, duration=e.duration,
+            magnitude=e.magnitude, spec=e.spec,
+        )
+        return done()
+    if op == "w_scale":
+        i = rng.randrange(len(wl))
+        e = wl[i]
+        wl[i] = WorkloadEvent(
+            t=e.t, kind=e.kind, target=e.target, duration=e.duration,
+            magnitude=round(max(0.05, e.magnitude * rng.uniform(0.5, 2.5)), 4),
+            spec=e.spec,
+        )
+        return done()
+    if op == "w_drop":
+        wl.pop(rng.randrange(len(wl)))
+        return done()
     if op == "add_divergence":
         # ISSUE 15: arm the speculative-divergence primary early and
         # crash it later — the schedule shape whose view change may
@@ -151,9 +247,7 @@ def mutate(
             t=round(min(0.85 * h, t0 + rng.uniform(0.1 * h, 0.4 * h)), 3),
             kind="crash",
         ))
-        events.sort(key=lambda e: (e.t, e.kind, e.target, e.spec))
-        return FaultSchedule(seed=sched.seed, horizon=h,
-                             events=tuple(events))
+        return done()
     if op == "flip_chain":
         # structured operator: take an existing cut and OVERLAP its
         # complementary direction on one member — "hear but can't
@@ -182,9 +276,7 @@ def mutate(
                 kind="partition", spec=spec,
                 duration=round(rng.uniform(0.3 * h, 0.85 * h), 3),
             ))
-            events.sort(key=lambda ev: (ev.t, ev.kind, ev.target, ev.spec))
-            return FaultSchedule(seed=sched.seed, horizon=h,
-                                 events=tuple(events))
+            return done()
     if op == "add_partition":
         events.append(FaultEvent(
             t=round(rng.uniform(0.03 * h, 0.8 * h), 3),
@@ -219,8 +311,7 @@ def mutate(
         events.append(replace_event(
             e, t=round(rng.uniform(0.03 * h, 0.85 * h), 3)
         ))
-    events.sort(key=lambda e: (e.t, e.kind, e.target, e.spec))
-    return FaultSchedule(seed=sched.seed, horizon=h, events=tuple(events))
+    return done()
 
 
 def replace_event(e: FaultEvent, **kw) -> FaultEvent:
@@ -319,8 +410,10 @@ def mode_sweep(args) -> Dict:
     for i in range(args.runs):
         seed = args.seed_base + i
         sc = base_scenario(args, seed)
-        sc = replace(sc, gen=sample_gen(random.Random(seed ^ 0xC0FFEE),
-                                        args.signed, qc=args.qc))
+        sc = replace(sc, gen=sample_gen(
+            random.Random(seed ^ 0xC0FFEE), args.signed, qc=args.qc,
+            workload=bool(getattr(args, "workload", None)),
+        ))
         if args.audit_every and i % args.audit_every == 0:
             res, code = audited_run(sc)
             stats["audits"] += 1
@@ -355,6 +448,14 @@ def mode_search(args) -> Dict:
                    "coverage_keys": {}, "corpus": 0}
     rng = random.Random(args.search_seed)
     ids = tuple(f"r{i}" for i in range(args.n))
+    use_wl = bool(getattr(args, "workload", None))
+    # class names for load-shape operators come from the preset's
+    # honest classes, so mutated events target classes that exist
+    wnames: Tuple[str, ...] = ("interactive", "bulk")
+    if use_wl:
+        wnames = tuple(
+            c.name for c in PRESETS[args.workload]().honest()
+        ) or wnames
     # corpus entries: (schedule, coverage_key)
     corpus: List[Tuple[FaultSchedule, Tuple]] = []
     key_counts: Dict[Tuple, int] = {}
@@ -367,11 +468,16 @@ def mode_search(args) -> Dict:
             # dwelling on; a saturated one barely draws mutations
             weights = [1.0 / (key_counts[k] ** 2) for (_, k) in corpus]
             parent = rng.choices(corpus, weights=weights, k=1)[0][0]
-            sched = mutate(rng, parent, ids)
+            sched = mutate(rng, parent, ids, workload=use_wl,
+                           wclasses=wnames)
             for _ in range(rng.randrange(0, 2)):
-                sched = mutate(rng, sched, ids)
+                sched = mutate(rng, sched, ids, workload=use_wl,
+                               wclasses=wnames)
         else:
-            gen = sample_gen(rng, args.signed, qc=args.qc)
+            gen = sample_gen(rng, args.signed, qc=args.qc,
+                             workload=use_wl)
+            if use_wl:
+                gen["class_names"] = wnames
             sched = FaultSchedule.generate(
                 seed=seed, horizon=args.horizon, replica_ids=ids, **gen
             )
@@ -450,6 +556,11 @@ def main() -> None:
                     help="verify signatures (slower; enables the audit "
                          "plane and byzantine injector kinds)")
     ap.add_argument("--qc", action="store_true", help="BLS QC mode")
+    ap.add_argument("--workload", default=None,
+                    choices=sorted(PRESETS),
+                    help="drive an open-loop traffic preset (ISSUE 17): "
+                         "arms the SLO oracles and adds load-shape "
+                         "mutation operators to the search")
     ap.add_argument("--defect", action="append", default=None,
                     help="arm a planted defect knob (validation mode; "
                          "repeatable). Known: sync_abandon_leak")

@@ -1,0 +1,243 @@
+#!/usr/bin/env python3
+"""traffic_report: render the traffic-observatory timeline from flight
+frames (ISSUE 17).
+
+Flight recorders append one ``telemetry.snapshot()`` JSONL line per
+virtual second; when the scenario carries an open-loop workload every
+frame embeds a ``traffic`` block with the plane's cumulative totals and
+a tail of recently closed windows (per-class offered / shed / wire /
+accepted counts + windowed latency percentiles). Frames at 1 s interval
+overlap heavily at 0.5 s windows, so the UNION of windows_tail entries
+across frames reconstructs the full per-window timeline — this tool
+stitches that union, joins the committee's ``committed_requests``
+counter deltas for a commit/s column, and prints:
+
+- one row per window: offered, accepted, shed, wire, commit/s, and
+  per-class offered→accepted with the window p99;
+- run totals per class (offered, accepted, accept ratio, shed, p99);
+- ``--json`` for the machine form.
+
+A flash-crowd triage session reads bottom-up: find the window where
+shed jumps, check whether accepted stayed ~flat (graceful: the plane
+sheds, the committee keeps committing) and whether one class's
+accepted→0 while another's holds (fairness bug — the shed_bulk_bias
+shape; see docs/SCENARIOS.md).
+
+Exit codes: 0 = rendered; 2 = no traffic blocks in the input (not a
+workload run, or recorders never fired).
+
+Usage:
+  python tools/traffic_report.py --flight-dir sim_flight/
+  python tools/traffic_report.py --flight flight_r0.jsonl --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def load_frames(paths: List[str]) -> List[Dict[str, Any]]:
+    """All parseable snapshot lines across the inputs, time-ordered.
+    Non-snapshot lines (autopsies, corrupt tails from a crash mid-write)
+    are skipped, not fatal — a post-hoc tool reads what survived."""
+    frames: List[Dict[str, Any]] = []
+    for path in paths:
+        try:
+            fh = open(path)
+        except OSError as e:
+            print(f"[traffic_report] skipping {path}: {e}", file=sys.stderr)
+            continue
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(doc, dict) and "t_mono" in doc:
+                    frames.append(doc)
+    frames.sort(key=lambda f: (f.get("t_mono", 0.0), str(f.get("node"))))
+    return frames
+
+
+def stitch_windows(frames: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Union of every frame's traffic.windows_tail, by window index —
+    the last frame to carry an index wins (windows are sealed once, so
+    duplicates are identical; 'last wins' just tolerates a frame cut
+    short mid-write)."""
+    by_w: Dict[int, Dict[str, Any]] = {}
+    for f in frames:
+        tr = f.get("traffic") or {}
+        for rec in tr.get("windows_tail") or []:
+            if isinstance(rec, dict) and "w" in rec:
+                by_w[int(rec["w"])] = rec
+    return [by_w[w] for w in sorted(by_w)]
+
+
+def commit_series(frames: List[Dict[str, Any]]) -> List[Tuple[float, int]]:
+    """(t_mono, committed_requests) per frame time, using the max across
+    replicas at each instant — the committee's forward edge, immune to
+    one lagging replica."""
+    by_t: Dict[float, int] = {}
+    for f in frames:
+        rep = f.get("replica") or {}
+        c = (rep.get("metrics") or {}).get("committed_requests")
+        if c is None:
+            continue
+        t = float(f.get("t_mono", 0.0))
+        by_t[t] = max(by_t.get(t, 0), int(c))
+    return sorted(by_t.items())
+
+
+def commit_rate_at(series: List[Tuple[float, int]], t: float) -> Optional[float]:
+    """committed requests/s from the frame pair bracketing virtual time
+    ``t`` (None outside the recorded range or on a degenerate pair).
+    ``t`` is PLANE-relative (window records count from the plane's
+    start); the series is clock-absolute — callers add the anchor, the
+    first frame's t_mono (recorders start right before the plane)."""
+    if len(series) < 2:
+        return None
+    for (t1, c1), (t2, c2) in zip(series, series[1:]):
+        if t1 <= t <= t2 and t2 > t1:
+            return (c2 - c1) / (t2 - t1)
+    return None
+
+
+def class_names(windows: List[Dict[str, Any]]) -> List[str]:
+    names: List[str] = []
+    for rec in windows:
+        for n in rec.get("classes") or {}:
+            if n not in names:
+                names.append(n)
+    return names
+
+
+def totals_by_class(windows: List[Dict[str, Any]],
+                    frames: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Per-class run totals. Counts fold from the stitched windows (the
+    authoritative per-window ledger); run-level p99 comes from the LAST
+    frame's cumulative traffic block (reservoir percentiles don't fold
+    across windows)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for rec in windows:
+        for n, c in (rec.get("classes") or {}).items():
+            t = out.setdefault(n, {"off": 0, "acc": 0, "shed": 0, "wire": 0})
+            for k in ("off", "acc", "shed", "wire"):
+                t[k] += int(c.get(k, 0))
+    last_classes: Dict[str, Any] = {}
+    for f in reversed(frames):
+        tr = f.get("traffic") or {}
+        if tr.get("classes"):
+            last_classes = tr["classes"]
+            break
+    for n, t in out.items():
+        t["accept_ratio"] = round(t["acc"] / t["off"], 4) if t["off"] else 0.0
+        lc = last_classes.get(n) or {}
+        t["p99_ms"] = lc.get("p99_ms")
+        t["byzantine"] = bool(lc.get("byzantine"))
+    return out
+
+
+def render(windows: List[Dict[str, Any]],
+           series: List[Tuple[float, int]],
+           classes: Dict[str, Dict[str, Any]]) -> str:
+    names = class_names(windows)
+    lines: List[str] = []
+    head = (f"{'W':>4} {'t':>8} {'offered':>8} {'accept':>7} "
+            f"{'shed':>7} {'wire':>6} {'cmt/s':>7}")
+    for n in names:
+        head += f"  {n[:12] + ' off>acc p99':>24}"
+    lines.append(head)
+    lines.append("-" * len(head))
+    for rec in windows:
+        cls = rec.get("classes") or {}
+        off = sum(int(c.get("off", 0)) for c in cls.values())
+        acc = sum(int(c.get("acc", 0)) for c in cls.values())
+        shed = sum(int(c.get("shed", 0)) for c in cls.values())
+        wire = sum(int(c.get("wire", 0)) for c in cls.values())
+        anchor = series[0][0] if series else 0.0
+        rate = commit_rate_at(series, anchor + float(rec.get("t", 0.0)))
+        rate_s = f"{rate:>7.0f}" if rate is not None else f"{'-':>7}"
+        row = (f"{rec['w']:>4} {rec.get('t', 0.0):>8.1f} {off:>8} "
+               f"{acc:>7} {shed:>7} {wire:>6} {rate_s}")
+        for n in names:
+            c = cls.get(n) or {}
+            cell = (f"{c.get('off', 0)}>{c.get('acc', 0)} "
+                    f"p99={c.get('p99_ms', 0.0):.0f}ms")
+            row += f"  {cell:>24}"
+        lines.append(row)
+    lines.append("")
+    lines.append("totals:")
+    for n in names:
+        t = classes.get(n) or {}
+        tag = " [byz]" if t.get("byzantine") else ""
+        lines.append(
+            f"  {n:<14} offered={t.get('off', 0):<8} "
+            f"accepted={t.get('acc', 0):<8} "
+            f"ratio={t.get('accept_ratio', 0.0):<7} "
+            f"shed={t.get('shed', 0):<8} "
+            f"p99={t.get('p99_ms')}ms{tag}"
+        )
+    return "\n".join(lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--flight-dir", default=None,
+                    help="directory of flight_*.jsonl frames "
+                         "(Scenario.flight_dir / deploy log dir)")
+    ap.add_argument("--flight", action="append", default=None,
+                    metavar="FILE", help="individual frame file "
+                                         "(repeatable)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    paths: List[str] = list(args.flight or [])
+    if args.flight_dir:
+        paths += sorted(
+            glob.glob(os.path.join(args.flight_dir, "flight_*.jsonl"))
+        ) or sorted(glob.glob(os.path.join(args.flight_dir, "*.jsonl")))
+    if not paths:
+        print("[traffic_report] no input: pass --flight-dir or --flight",
+              file=sys.stderr)
+        sys.exit(2)
+
+    frames = load_frames(paths)
+    windows = stitch_windows(frames)
+    if not windows:
+        print("[traffic_report] no traffic blocks in "
+              f"{len(frames)} frames across {len(paths)} files "
+              "(not a workload run?)", file=sys.stderr)
+        sys.exit(2)
+    series = commit_series(frames)
+    classes = totals_by_class(windows, frames)
+
+    if args.json:
+        print(json.dumps({
+            "files": len(paths),
+            "frames": len(frames),
+            "windows": windows,
+            "classes": classes,
+            "commit_series": series,
+        }, sort_keys=True))
+    else:
+        print(f"[traffic_report] {len(paths)} files, {len(frames)} frames, "
+              f"{len(windows)} windows")
+        print(render(windows, series, classes))
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+#!/usr/bin/env python3
+"""traffic_smoke: CI gate for the traffic observatory (ISSUE 17).
+
+One invocation proves the whole plane end to end, both directions:
+
+1. SMOKE — drive the ``smoke1e5`` preset open-loop on the virtual
+   clock (10^5+ distinct virtual clients over a bounded transport
+   pool), require the run ok, every SLO oracle family judged
+   (p99 / starvation / shed-before-collapse), and >= --min-clients
+   distinct clients touched.
+2. RENDER — the run's flight frames must stitch into a non-empty
+   per-window timeline through tools/traffic_report.py (the post-hoc
+   triage path stays alive).
+3. LEDGER — append a schema-pinned bench line (``cell:
+   traffic_smoke``) for tools/bench_gate.py's ``traffic.*`` rows
+   (floors-mode reference: bench_results/traffic_ci_reference.jsonl).
+4. CANARY — re-run the ``overload`` preset with the planted
+   ``shed_bulk_bias`` defect armed and REQUIRE the starvation oracle
+   to fail the run. A green smoke with a green canary means the
+   oracles both pass honest runs and catch a real fairness bug — an
+   oracle that cannot fail is not an oracle.
+
+Exit codes: 0 = all gates pass; 1 = a gate failed; 2 = structural
+(run crashed, no flight frames, ledger unwritable).
+
+Usage:
+  python tools/traffic_smoke.py --out /tmp/traffic_smoke
+  python tools/traffic_smoke.py --out /tmp/ts --skip-canary --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from simple_pbft_tpu.sim import Scenario, run_scenario  # noqa: E402
+from simple_pbft_tpu.telemetry import BENCH_SCHEMA_VERSION  # noqa: E402
+from tools import traffic_report  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--out", default="traffic_smoke_out",
+                    help="flight frames + ledger land here")
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--preset", default="smoke1e5")
+    ap.add_argument("--horizon", type=float, default=30.0,
+                    help="30 s at smoke1e5 rates wraps the full "
+                         "110k-client population")
+    ap.add_argument("--min-clients", type=int, default=100_000)
+    ap.add_argument("--wall-timeout", type=float, default=480.0)
+    ap.add_argument("--skip-canary", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    flight_dir = os.path.join(args.out, "flight")
+    os.makedirs(flight_dir, exist_ok=True)
+    gates: Dict[str, Any] = {}
+
+    # 1. smoke ------------------------------------------------------------
+    sc = Scenario(
+        seed=args.seed, horizon=args.horizon,
+        workload={"preset": args.preset}, flight_dir=flight_dir,
+        name=f"traffic_smoke_{args.preset}",
+    )
+    res = run_scenario(sc, wall_timeout=args.wall_timeout)
+    touched = res.coverage.get("clients_touched", 0)
+    slo = res.details.get("slo") or {}
+    judged_all = all(k in slo for k in
+                     ("p99", "starvation", "shed_before_collapse"))
+    gates["smoke"] = {
+        "ok": bool(res.ok and judged_all and touched >= args.min_clients),
+        "run_ok": res.ok,
+        "failure": res.failure,
+        "clients_touched": touched,
+        "min_clients": args.min_clients,
+        "slo_judged": judged_all,
+        "slo": slo,
+        "offered": res.coverage.get("offered", 0),
+        "accepted": res.coverage.get("accepted", 0),
+        "wall_s": res.wall_s,
+        "vtime_s": res.vtime_s,
+    }
+
+    # 2. render -----------------------------------------------------------
+    paths = sorted(glob.glob(os.path.join(flight_dir, "flight_*.jsonl")))
+    frames = traffic_report.load_frames(paths)
+    windows = traffic_report.stitch_windows(frames)
+    gates["render"] = {
+        "ok": bool(windows),
+        "files": len(paths), "frames": len(frames),
+        "windows": len(windows),
+    }
+
+    # 3. ledger -----------------------------------------------------------
+    bench = res.details.get("traffic_bench") or {}
+    ledger_path = os.path.join(args.out, "traffic_bench.jsonl")
+    gates["ledger"] = {"ok": bool(bench), "path": ledger_path}
+    if bench:
+        try:
+            with open(ledger_path, "a") as f:
+                f.write(json.dumps({
+                    "schema_version": BENCH_SCHEMA_VERSION,
+                    "cell": "traffic_smoke",
+                    "traffic": bench,
+                }, sort_keys=True) + "\n")
+        except OSError as e:
+            gates["ledger"] = {"ok": False, "error": str(e)}
+
+    # 4. canary -----------------------------------------------------------
+    if not args.skip_canary:
+        canary = run_scenario(Scenario(
+            seed=args.seed, workload={"preset": "overload"},
+            defects=("shed_bulk_bias",), name="traffic_canary",
+        ), wall_timeout=args.wall_timeout)
+        caught = bool(
+            canary.failure
+            and canary.failure.startswith("slo:starved-class")
+        )
+        gates["canary"] = {
+            "ok": caught,
+            "failure": canary.failure,
+            "expected": "slo:starved-class:*",
+            "wall_s": canary.wall_s,
+        }
+
+    ok = all(g.get("ok") for g in gates.values())
+    report = {"ok": ok, "gates": gates}
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        for name, g in gates.items():
+            mark = "PASS" if g.get("ok") else "FAIL"
+            detail = {k: v for k, v in g.items()
+                      if k not in ("ok", "slo") and v is not None}
+            print(f"[traffic_smoke] {mark} {name}: {detail}")
+        print(f"[traffic_smoke] {'PASS' if ok else 'FAIL'}")
+    if not gates["smoke"]["run_ok"] and gates["smoke"]["failure"] is None:
+        sys.exit(2)  # crashed without a verdict: structural
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
