@@ -27,6 +27,7 @@ class LocalCommittee:
     clients: List[Client] = field(default_factory=list)
     lag_gauge: Optional[object] = None  # LoopLagGauge (attach_loop_lag)
     traffic_stats: Optional[object] = None  # workload.TrafficStats (ISSUE 17)
+    knob_registry: Optional[object] = None  # controller.KnobRegistry (ISSUE 19)
 
     @staticmethod
     def build(
@@ -117,6 +118,7 @@ class LocalCommittee:
                     node_id, replica=r, transport=r.transport,
                     tracer=r.tracer, loop_lag=self.lag_gauge,
                     traffic=self.traffic_stats,
+                    knobs=self.knob_registry,
                 )
         for c in self.clients:
             if c.id == node_id:
@@ -124,8 +126,19 @@ class LocalCommittee:
                     node_id, client=c, transport=c.transport,
                     tracer=c.tracer, loop_lag=self.lag_gauge,
                     traffic=self.traffic_stats,
+                    knobs=self.knob_registry,
                 )
         raise KeyError(node_id)
+
+    def attach_knobs(self):
+        """Build the standard knob registry over this committee (ISSUE
+        19 perf plane) and surface it in every node's telemetry. Returns
+        the registry; a KnobController is attached separately (sim.py
+        does both when a scenario asks for the controller)."""
+        from .controller import registry_for_committee
+
+        self.knob_registry = registry_for_committee(self)
+        return self.knob_registry
 
     def attach_loop_lag(self, interval: float = 0.1):
         """Start the committee's event-loop lag gauge (ISSUE 4: one loop

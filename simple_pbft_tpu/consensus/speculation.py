@@ -101,6 +101,10 @@ class SpeculationEngine:
         # speculation is only worth its bookkeeping when the app can be
         # forked at all; EchoApp/KVStore can, exotic apps may not
         self.enabled = self.app.forkable()
+        # cap on concurrently open speculative slots (ISSUE 19 knob
+        # spec.max_depth): bounds the rollback blast radius — every
+        # open slot is work a view-change divergence can discard
+        self.max_depth = 64
         self.slots: Dict[int, SpecSlot] = {}
         # set by rollback(), consumed by re_speculate(): the execute
         # drain re-speculates only after a rollback actually discarded
@@ -124,6 +128,9 @@ class SpeculationEngine:
         if seq <= r.executed_seq or seq in self.slots:
             return None
         if inst.block is None or inst.digest is None:
+            return None
+        if self.max_depth and len(self.slots) >= self.max_depth:
+            r.metrics["spec_skipped_depth"] += 1
             return None
         reqs = r._validate_block(inst.block, inst.digest)
         if reqs is None:
@@ -430,6 +437,7 @@ class SpeculationEngine:
     def snapshot(self) -> Dict[str, int]:
         return {
             "enabled": int(self.enabled),
+            "max_depth": self.max_depth,
             "open_slots": len(self.slots),
             "fork_open": int(self.app.spec_open()),
             "forks_built": self.app.forks_built,

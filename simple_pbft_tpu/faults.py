@@ -645,6 +645,27 @@ def _wan3dc(ids: Sequence[str], seed: int = 0) -> Dict[str, Dict[str, LinkShape]
     }
 
 
+@_profile("wan_thin")
+def _wan_thin(ids: Sequence[str], seed: int = 0) -> Dict[str, Dict[str, LinkShape]]:
+    """wan3dc's topology with BANDWIDTH-LIMITED inter-DC links: 256
+    KB/s per directed link. Block bytes now serialize in virtual time,
+    so committee throughput is finite and over-admission queues for
+    real — the load shape the knob campaign (ISSUE 19) swings shed
+    watermarks against. Jitter-free and lossless on purpose: the
+    campaign compares tunings, and retransmission noise would blur the
+    queueing signal it measures."""
+    dc = {rid: i % 3 for i, rid in enumerate(ids)}
+    lan = LinkShape(delay_s=0.0003, jitter_s=0.0001)
+    wan = LinkShape(delay_s=0.012, bw_bytes_per_s=256_000.0)
+    return {
+        src: {
+            dst: (lan if dc[src] == dc[dst] else wan)
+            for dst in ids if dst != src
+        }
+        for src in ids
+    }
+
+
 @_profile("lossy")
 def _lossy(ids: Sequence[str], seed: int = 0) -> Dict[str, Dict[str, LinkShape]]:
     """Every link pays a few ms and drops 5% of frames iid — the

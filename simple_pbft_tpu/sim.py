@@ -276,6 +276,14 @@ class Scenario:
     workload: Optional[Dict[str, Any]] = None
     slo: Dict[str, Any] = field(default_factory=dict)  # judge_slo overrides
     flight_dir: Optional[str] = None  # write per-replica flight frames here
+    # self-driving perf plane (ISSUE 19). ``knobs``: fixed settings
+    # {knob name -> ladder value} applied through the KnobRegistry after
+    # build (the campaign's fixed-knob cells). ``controller``: online
+    # KnobController config ({interval, profile, cooldown_ticks,
+    # effect_ticks, osc_window_ticks, freeze_ticks, ledger}); None = off
+    # — pre-ISSUE-19 scenarios replay with identical fingerprints.
+    knobs: Dict[str, Any] = field(default_factory=dict)
+    controller: Optional[Dict[str, Any]] = None
     name: str = ""
 
     def replica_ids(self) -> Tuple[str, ...]:
@@ -329,6 +337,8 @@ class Scenario:
             "defects": list(self.defects),
             "workload": self.workload,
             "slo": dict(self.slo),
+            "knobs": dict(self.knobs),
+            "controller": self.controller,
             "name": self.name,
         }
 
@@ -354,6 +364,8 @@ class Scenario:
             defects=tuple(doc.get("defects", ())),
             workload=doc.get("workload") or None,
             slo=dict(doc.get("slo", {})),
+            knobs=dict(doc.get("knobs", {})),
+            controller=doc.get("controller") or None,
             name=str(doc.get("name", "")),
         )
 
@@ -535,8 +547,46 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
             note=lambda **kv: trace.note("load", **kv),
         )
         com.traffic_stats = plane.stats
+    controller = None
+    registry = None
+    knob_baseline: Dict[str, Any] = {}
+    final_knobs: Dict[str, Any] = {}
+    if sc.knobs or sc.controller is not None:
+        # perf plane (ISSUE 19): fixed knob cells go through the same
+        # bounds-enforcing registry the online controller uses — an
+        # off-ladder campaign cell fails loudly here, not silently
+        registry = com.attach_knobs()
+        # some knob targets are process-global (the QC verify lane is a
+        # singleton) — snapshot before touching so this run's tuning
+        # can't leak into the next run_scenario in the same process
+        knob_baseline = registry.values()
+        for kname in sorted(sc.knobs):
+            registry.set(kname, sc.knobs[kname])
     try:
         com.start()
+        if sc.controller is not None:
+            from .controller import KnobController
+
+            cdoc = dict(sc.controller)
+            ledger_path = cdoc.pop("ledger", None)
+            if ledger_path is None and sc.flight_dir:
+                ledger_path = (
+                    f"{sc.flight_dir}/{sc.name or 'sim'}.knobs.jsonl"
+                )
+            # the controller watches the PRIMARY's snapshot: traffic/
+            # qc/knob blocks are committee-wide and the primary owns the
+            # backlog the admission rules react to
+            tel = com.node_telemetry(com.replicas[0].id)
+            controller = KnobController(
+                registry, tel.snapshot, ledger_path,
+                interval=float(cdoc.pop("interval", 0.5)),
+                profile=str(cdoc.pop("profile", "default")),
+                cooldown_ticks=int(cdoc.pop("cooldown_ticks", 2)),
+                effect_ticks=int(cdoc.pop("effect_ticks", 2)),
+                osc_window_ticks=int(cdoc.pop("osc_window_ticks", 6)),
+                freeze_ticks=int(cdoc.pop("freeze_ticks", 8)),
+            )
+            controller.start()
         for c in com.clients:
             c.request_timeout = sc.request_timeout
         if sc.flight_dir:
@@ -605,6 +655,8 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
         for fr in flight_recorders:
             await fr.stop()
         flight_recorders = []
+        if controller is not None:
+            await controller.stop()  # seals the decision ledger
         await com.stop()
     finally:
         statesync_mod.DEFECTS.clear()
@@ -618,8 +670,23 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
                 await fr.stop()
             except Exception:
                 pass
+        if controller is not None and controller._task is not None:
+            try:  # failure path: the happy path already stopped it
+                await controller.stop()
+            except Exception:
+                pass
         for a in auditors.values():
             a.close()
+        if registry is not None:
+            # read the tuned values for details, then put process-global
+            # knob targets (qc lane singleton) back as we found them so
+            # back-to-back runs in one process stay seed-deterministic
+            final_knobs = registry.values()
+            for kname, kval in sorted(knob_baseline.items()):
+                try:
+                    registry.set(kname, kval)
+                except Exception:
+                    pass
 
     # ---- oracles + coverage over the final state ----------------------
     byz = sorted({w.node_id for w in injector.byzantine})
@@ -769,6 +836,7 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
                 100 * (t["shed"] + replica_shed) / max(1, t["offered"])
             ),
             "worst_p99_ms": int(stats.worst_honest_p99_ms()),
+            "worst_e2e_p99_ms": int(stats.worst_honest_e2e_p99_ms()),
             "fair_gap_pct": int(
                 100 * (max(honest_ratios) - min(honest_ratios))
             ) if honest_ratios else 0,
@@ -795,6 +863,31 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
             sc.horizon
         )
         details["slo"] = slo_verdicts
+    if registry is not None:
+        # perf plane (ISSUE 19): final knob values, controller activity,
+        # and the PBL006 invariant (zero post-warm compiles while the
+        # controller moved batch-shape knobs) — knob_campaign reads this
+        pwc = 0
+        for r in com.replicas:
+            snap_fn = getattr(getattr(r, "verifier", None), "snapshot", None)
+            if callable(snap_fn):
+                try:
+                    shapes = snap_fn().get("device_shapes") or {}
+                    pwc += int(shapes.get("post_warm_compiles", 0) or 0)
+                except Exception:
+                    pass
+        ctl: Dict[str, Any] = {
+            "knobs": final_knobs,
+            "post_warm_compiles": pwc,
+        }
+        if controller is not None:
+            ctl.update(controller.coverage())
+            ctl["ledger"] = (
+                controller.ledger.path if controller.ledger else ""
+            )
+            cov["ctl_actions"] = controller.actions
+            cov["ctl_oscillations"] = controller.oscillations
+        details["controller"] = ctl
     return SimResult(
         ok=failure is None,
         failure=failure,

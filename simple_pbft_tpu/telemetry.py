@@ -339,6 +339,7 @@ class NodeTelemetry:
         tracer: Optional["RequestTracer"] = None,
         loop_lag: Optional["LoopLagGauge"] = None,
         traffic=None,
+        knobs=None,
     ) -> None:
         self.node_id = node_id
         self.replica = replica
@@ -350,6 +351,9 @@ class NodeTelemetry:
         # plane's per-class offered/accepted/shed/latency accounting —
         # plane-wide, reported identically by every in-process node
         self.traffic = traffic
+        # controller.KnobRegistry (ISSUE 19): live knob values + bounds
+        # and the controller's posture — committee-wide, like traffic
+        self.knobs = knobs
         self._t0 = clock.now()
 
     def snapshot(self) -> Dict[str, Any]:
@@ -391,6 +395,12 @@ class NodeTelemetry:
             # — pbft_top's LOAD column and tools/traffic_report.py read
             # this (additive key: SCHEMA_VERSION unchanged)
             snap["traffic"] = self.traffic.snapshot_block()
+        if self.knobs is not None:
+            # self-driving perf plane (ISSUE 19): knob values/bounds +
+            # controller posture — pbft_top's CTL column reads this
+            # (additive key: SCHEMA_VERSION unchanged, per the stability
+            # contract above)
+            snap["knobs"] = self.knobs.snapshot_block()
         if self.tracer is not None:
             snap["tracer"] = {
                 "sample_mod": self.tracer.sample_mod,

@@ -374,6 +374,47 @@ def _million() -> WorkloadSpec:
     )
 
 
+@_preset("swing")
+def _swing() -> WorkloadSpec:
+    """Idle→storm→drain swing (the ISSUE 19 controller acceptance
+    driver). The idle baseline is comfortable for the mid shed
+    watermark; the storm (a ``swing_events`` burst over the middle
+    third) outruns a thin-WAN committee's commit throughput so an
+    over-admitting watermark queues past client patience, while an
+    over-shedding one pays the synchronized-retry quantum at idle.
+    ``op_bytes`` is deliberately heavy: block bytes are what the WAN
+    serializes, so admission control has real teeth. Pair with
+    ``swing_events(horizon)`` and a ``shape`` fault event (see
+    tools/knob_campaign.py)."""
+    return WorkloadSpec(
+        classes=(
+            ClientClass("interactive", rate=60.0, clients=4000,
+                        read_fraction=0.4, op_bytes=192,
+                        hot_clients=32, hot_fraction=0.2),
+            ClientClass("bulk", rate=20.0, clients=600, op_bytes=256),
+            ClientClass("byzantine", rate=0.0, clients=400,
+                        byzantine=True),
+        ),
+        wire_per_window=768, max_inflight=2048, clusters=2,
+        shed_watermark=64, patience=4.0,
+    )
+
+
+def swing_events(
+    horizon: float, magnitude: float = 10.0
+) -> Tuple[WorkloadEvent, ...]:
+    """The canonical idle→storm→drain event shape over ``horizon``: one
+    interactive burst spanning the middle third. The knob campaign and
+    the controller-smoke CI job share this single definition so the
+    acceptance cell cannot drift between them."""
+    return (
+        WorkloadEvent(
+            t=round(horizon / 3.0, 3), kind="burst", target="interactive",
+            duration=round(horizon / 3.0, 3), magnitude=magnitude,
+        ),
+    )
+
+
 # ---------------------------------------------------------------------------
 # deterministic arrival generation
 # ---------------------------------------------------------------------------
@@ -648,6 +689,13 @@ class TrafficStats:
         self.windows: List[Dict[str, Any]] = []
         self._lat: Dict[str, List[float]] = {n: [] for n in self.class_names}
         self._lat_n: Dict[str, int] = {n: 0 for n in self.class_names}
+        # end-to-end reservoirs (ISSUE 19): latency anchored at the
+        # request's FIRST launch, carried across plane-owned retries.
+        # Per-attempt latency above resets per retry, which makes
+        # shedding invisible to p99 — a controller tuned on it would
+        # learn to shed everything. E2E is what the knob campaign gates.
+        self._e2e: Dict[str, List[float]] = {n: [] for n in self.class_names}
+        self._e2e_n: Dict[str, int] = {n: 0 for n in self.class_names}
         self._win_acc: Dict[str, int] = z()
         self._win_lat: Dict[str, List[float]] = {
             n: [] for n in self.class_names
@@ -666,6 +714,15 @@ class TrafficStats:
         win = self._win_lat[cls]
         if len(win) < WINDOW_SAMPLES:
             win.append(latency)
+
+    def note_e2e(self, cls: str, latency: float) -> None:
+        n = self._e2e_n[cls]
+        self._e2e_n[cls] = n + 1
+        res = self._e2e[cls]
+        if len(res) < LATENCY_RESERVOIR:
+            res.append(latency)
+        else:
+            res[(n * 2654435761) % LATENCY_RESERVOIR] = latency
 
     def complete(self, cls: str, outcome: str,
                  latency: float = 0.0) -> None:
@@ -711,6 +768,14 @@ class TrafficStats:
     def p50_ms(self, cls: str) -> float:
         return round(_percentile(self._lat[cls], 0.50) * 1000, 1)
 
+    def e2e_p99_ms(self, cls: str) -> float:
+        return round(_percentile(self._e2e[cls], 0.99) * 1000, 1)
+
+    def worst_honest_e2e_p99_ms(self) -> float:
+        vals = [self.e2e_p99_ms(n) for n in self.class_names
+                if n not in self.byz_names and self._e2e[n]]
+        return max(vals) if vals else 0.0
+
     def accept_ratio(self, cls: str) -> float:
         off = self.offered[cls]
         return (self.accepted[cls] / off) if off else 0.0
@@ -744,6 +809,7 @@ class TrafficStats:
             **t,
             "windows_total": len(self.windows),
             "worst_p99_ms": self.worst_honest_p99_ms(),
+            "worst_e2e_p99_ms": self.worst_honest_e2e_p99_ms(),
             "peak_inflight": self.peak_inflight,
             "classes": {},
             "windows_tail": self.windows[-WINDOWS_TAIL:],
@@ -769,6 +835,7 @@ class TrafficStats:
                 "byzantine": n in self.byz_names,
                 "p50_ms": self.p50_ms(n),
                 "p99_ms": self.p99_ms(n),
+                "e2e_p99_ms": self.e2e_p99_ms(n),
                 "accept_ratio": round(self.accept_ratio(n), 4),
             }
         return block
@@ -784,11 +851,13 @@ class TrafficStats:
             "accepted_req_s": round(t["accepted"] / max(1e-9, horizon), 2),
             "shed_fraction": round(t["shed"] / max(1, t["offered"]), 4),
             "worst_p99_ms": self.worst_honest_p99_ms(),
+            "worst_e2e_p99_ms": self.worst_honest_e2e_p99_ms(),
         }
         for n in self.class_names:
             if n in self.byz_names:
                 continue
             flat[f"{n}_p99_ms"] = self.p99_ms(n)
+            flat[f"{n}_e2e_p99_ms"] = self.e2e_p99_ms(n)
             flat[f"{n}_accept_ratio"] = round(self.accept_ratio(n), 4)
         return flat
 
@@ -825,8 +894,10 @@ class TrafficPlane:
         self._rr = 0
         self._flood_ts = 0
         self._tasks: set = set()
-        # (cls, op, attempts_left) re-fired at the next cluster instant
-        self._requeue: List[Tuple[str, str, int]] = []
+        # (cls, op, attempts_left, born) re-fired at the next cluster
+        # instant; ``born`` anchors e2e latency at the FIRST launch so
+        # retry waves stay visible in the e2e reservoirs (ISSUE 19)
+        self._requeue: List[Tuple[str, str, int, float]] = []
         self._attempts = max(1, int(spec.patience / max(
             0.25, getattr(self.pool[0], "request_timeout", 1.0)
         ))) if self.pool else 1
@@ -834,7 +905,7 @@ class TrafficPlane:
     # -- submission path ---------------------------------------------------
 
     def _launch(self, cls: str, op: str, attempts: int,
-                win: Dict[str, int]) -> None:
+                win: Dict[str, int], born: float = -1.0) -> None:
         if len(self._tasks) >= self.spec.max_inflight:
             # pool saturated: exact ingress-shed accounting, no wire
             self.stats.shed_ingress[cls] += 1
@@ -843,7 +914,7 @@ class TrafficPlane:
         c = self.pool[self._rr % len(self.pool)]
         self._rr += 1
         task = self._asyncio.get_running_loop().create_task(
-            self._one(c, cls, op, attempts)
+            self._one(c, cls, op, attempts, born)
         )
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
@@ -851,10 +922,13 @@ class TrafficPlane:
             self.stats.peak_inflight, len(self._tasks)
         )
 
-    async def _one(self, client, cls: str, op: str, attempts: int) -> None:
+    async def _one(self, client, cls: str, op: str, attempts: int,
+                   born: float = -1.0) -> None:
         from .client import SupersededError
 
         t0 = clock.now()
+        if born < 0:
+            born = t0  # first attempt: this launch IS the arrival
         try:
             # single-attempt submits: the PLANE owns retries, re-firing
             # them in synchronized clusters (correlated retry waves) —
@@ -862,10 +936,11 @@ class TrafficPlane:
             # a virtual clock (see module doc)
             await client.submit(op, retries=0)
             self.stats.complete(cls, "accepted", clock.now() - t0)
+            self.stats.note_e2e(cls, clock.now() - born)
         except self._asyncio.TimeoutError:
             if attempts > 1:
                 self.stats.requeued[cls] += 1
-                self._requeue.append((cls, op, attempts - 1))
+                self._requeue.append((cls, op, attempts - 1, born))
             else:
                 self.stats.complete(cls, "timeouts")
         except SupersededError:
@@ -931,12 +1006,12 @@ class TrafficPlane:
             # for fairness); the requeue list folds into the first
             # cluster (synchronized retry wave)
             att = max(1, int(round(self._attempts * storm)))
-            clusters: List[List[Tuple[str, str, int]]] = [
+            clusters: List[List[Tuple[str, str, int, float]]] = [
                 [] for _ in range(k)
             ]
             for t, cls, op in plan.arrivals:
                 j = min(k - 1, int((t - plan.t0) / sp.window * k))
-                clusters[j].append((cls, op, att))
+                clusters[j].append((cls, op, att, -1.0))
             if self._requeue:
                 clusters[0].extend(self._requeue)
                 self._requeue = []
@@ -948,8 +1023,8 @@ class TrafficPlane:
                 dt = t_fire - clock.now()
                 if dt > 0:
                     await clock.sleep(dt)
-                for cls, op, att in batch:
-                    self._launch(cls, op, att, wire_sent)
+                for cls, op, att, born in batch:
+                    self._launch(cls, op, att, wire_sent, born)
                 flood_n = (
                     plan.floods - floods_per * (k - 1)
                     if j == k - 1 else floods_per
@@ -974,8 +1049,8 @@ class TrafficPlane:
         # leftover synchronized retries get one final wave
         if self._requeue:
             wire_sent = {}
-            for cls, op, att in self._requeue:
-                self._launch(cls, op, 1, wire_sent)
+            for cls, op, att, born in self._requeue:
+                self._launch(cls, op, 1, wire_sent, born)
             self._requeue = []
             for n, v in wire_sent.items():
                 self.stats.wire[n] += v

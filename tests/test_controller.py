@@ -1,0 +1,494 @@
+"""Self-driving perf plane (ISSUE 19): knob registry bounds, decision
+rule true-positive/true-negative behavior per verdict family, the
+oscillation guard, hash-chained decision-ledger determinism + replay,
+the speculation depth gate, and the bench_gate ``controller.*`` rows
+with their pathological-knob canary.
+
+Rule tests drive ``KnobController.tick(snap)`` synchronously with
+synthetic telemetry snapshots — no loop, no committee. Ledger
+determinism runs the full sim twice on the virtual clock and compares
+file bytes."""
+
+import asyncio
+import json
+
+import pytest
+
+from simple_pbft_tpu.committee import LocalCommittee
+from simple_pbft_tpu.controller import (
+    CALM_TICKS,
+    GENESIS,
+    Knob,
+    KnobController,
+    KnobRegistry,
+    RULES,
+    RULES_BY_NAME,
+    WIN_P99_FAST_MS,
+    WIN_P99_STORM_MS,
+    chain_hash,
+    parse_decision_ledger,
+    registry_for_committee,
+    replay_ledger,
+)
+from simple_pbft_tpu.sim import Scenario, run_scenario
+from simple_pbft_tpu.telemetry import BENCH_SCHEMA_VERSION, SCHEMA_VERSION
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+# ---------------------------------------------------------------------------
+# synthetic harness: toy registry + snapshot builder
+# ---------------------------------------------------------------------------
+
+
+def _toy_registry():
+    state = {
+        "replica.shed_watermark": 64,
+        "qc.close_window_ms": 2.0,
+        "verify.max_batch": 512,
+        "verify.cpu_cutoff": -1,
+        "spec.max_depth": 64,
+    }
+    ladders = {
+        "replica.shed_watermark": (8, 16, 32, 64, 96, 128, 192, 256),
+        "qc.close_window_ms": (0.5, 1.0, 2.0, 4.0, 8.0),
+        "verify.max_batch": (64, 128, 256, 512),
+        "verify.cpu_cutoff": (16, 64, 256, 1024, -1),
+        "spec.max_depth": (4, 16, 64),
+    }
+    reg = KnobRegistry()
+    for name, choices in ladders.items():
+        reg.register(Knob(
+            name=name, doc="toy", choices=choices,
+            get=(lambda n=name: state[n]),
+            set=(lambda v, n=name: state.__setitem__(n, v)),
+        ))
+    return reg, state
+
+
+def _snap(*, offered=80.0, accepted=80.0, win_p99=50.0, shed=0,
+          pending=0, rollbacks=0, verify=None, qc=None):
+    return {
+        "traffic": {
+            "offered_req_s": offered,
+            "accepted_req_s": accepted,
+            "worst_p99_ms": win_p99,
+            "classes": {
+                "interactive": {"byzantine": False, "p99_ms": win_p99},
+                "byz": {"byzantine": True, "p99_ms": 9999.0},
+            },
+            "windows_tail": [{"classes": {
+                "interactive": {"p99_ms": win_p99},
+                "byz": {"p99_ms": 9999.0},
+            }}],
+        },
+        "replica": {
+            "pending_requests": pending, "relay_buffer": 0,
+            "metrics": {"messages_shed": shed,
+                        "spec_rollbacks": rollbacks},
+        },
+        "verify": verify or {},
+        "qc_lane": qc or {},
+    }
+
+
+def _controller(tmp_path=None, **kw):
+    reg, state = _toy_registry()
+    kw.setdefault("cooldown_ticks", 0)
+    path = str(tmp_path / "t.knobs.jsonl") if tmp_path else None
+    ctl = KnobController(reg, dict, ledger_path=path, **kw)
+    return ctl, state
+
+
+# ---------------------------------------------------------------------------
+# traffic family: storm cut / served-inflow relax
+# ---------------------------------------------------------------------------
+
+
+def test_storm_cut_fires_on_shed_wave():
+    """TP: a shed wave far above the watermark scale reads as storm
+    and steps the watermark DOWN, even with the window p99 fast (the
+    fail-fast brownout direction)."""
+    ctl, state = _controller()
+    ctl.tick(_snap(shed=0))  # baseline for the cumulative counters
+    ctl.tick(_snap(shed=1000, offered=600.0, accepted=100.0))
+    assert state["replica.shed_watermark"] == 32
+    assert ctl._last_info["rule"] == "storm_backlog"
+
+
+def test_storm_cut_fires_on_window_p99():
+    """TP: queue buildup shows as the last closed window's honest p99
+    inflating — cut even when nothing is shed yet. The byzantine
+    class's p99 must NOT count (it is 9999 in every snapshot here)."""
+    ctl, state = _controller()
+    ctl.tick(_snap())
+    ctl.tick(_snap(win_p99=WIN_P99_STORM_MS + 50))
+    assert state["replica.shed_watermark"] == 32
+    assert ctl._last_info["rule"] == "storm_backlog"
+
+
+def test_no_cut_in_dead_band():
+    """TN: a window p99 between FAST and STORM with no shed wave moves
+    nothing — the dead band is the hysteresis."""
+    ctl, state = _controller()
+    ctl.tick(_snap(win_p99=WIN_P99_FAST_MS + 20))
+    ctl.tick(_snap(win_p99=WIN_P99_STORM_MS - 20))
+    assert state["replica.shed_watermark"] == 64
+    assert ctl.actions == 0
+
+
+def test_relax_requires_served_inflow():
+    """The served-ratio interlock: sheds with fresh inflow NOT served
+    (a strangled backlog) must never relax the watermark; the same
+    shed trickle with inflow fully served relaxes it."""
+    ctl, state = _controller()
+    ctl.tick(_snap(shed=0))
+    # TN: shedding while only a quarter of fresh inflow is served
+    ctl.tick(_snap(shed=40, offered=80.0, accepted=20.0))
+    assert state["replica.shed_watermark"] == 64
+    # TP: shedding while inflow is served => the watermark is trimming
+    # benign traffic; step UP
+    ctl.tick(_snap(shed=80, offered=80.0, accepted=80.0))
+    assert state["replica.shed_watermark"] == 96
+    assert ctl._last_info["rule"] == "drain_relax"
+
+
+# ---------------------------------------------------------------------------
+# devledger / costmodel / qc / spec families
+# ---------------------------------------------------------------------------
+
+
+def test_pad_waste_shrinks_batch():
+    ctl, state = _controller()
+    ctl.tick(_snap())
+    ctl.tick(_snap(verify={
+        "pending_items": 0, "max_pending": 4096,
+        "device": {"occupancy": 0.1, "pad_waste_pct": 70.0},
+    }))
+    assert state["verify.max_batch"] == 256
+    assert ctl._last_info["rule"] == "pad_waste"
+
+
+def test_queue_pressure_grows_batch():
+    ctl, state = _controller()
+    ctl.tick(_snap())
+    ctl.tick(_snap(verify={
+        "pending_items": 3500, "max_pending": 4096,
+        "device": {"occupancy": 0.9, "pad_waste_pct": 5.0},
+    }))
+    assert state["verify.max_batch"] == 512  # already at the ceiling
+    assert ctl.actions == 0  # no-op step is skipped, not ledgered
+    state["verify.max_batch"] = 256
+    ctl.tick(_snap(verify={
+        "pending_items": 3500, "max_pending": 4096,
+        "device": {"occupancy": 0.9, "pad_waste_pct": 5.0},
+    }))
+    assert state["verify.max_batch"] == 512
+    assert ctl._last_info["rule"] == "queue_wait"
+
+
+def test_host_cpu_path_lowers_cutoff():
+    """TP: most verify items landing on the CPU pass with a device
+    present reads as a mis-set cutoff — step it DOWN (toward forcing
+    the device path)."""
+    ctl, state = _controller()
+    ctl.tick(_snap())
+    ctl.tick(_snap(verify={
+        "pending_items": 10, "max_pending": 4096,
+        "cpu_pass_items": 900, "device_pass_items": 100,
+        "device": {"occupancy": 0.9},
+    }))
+    assert state["verify.cpu_cutoff"] == 1024
+    assert ctl._last_info["rule"] == "host_cpu_path"
+
+
+def test_qc_idle_needs_calm_ticks():
+    """Hysteresis: an empty QC lane only narrows the close window
+    after CALM_TICKS quiet ticks — one idle snapshot is not calm."""
+    ctl, state = _controller()
+    ctl.tick(_snap(qc={"pending": 0, "max_pending": 4096}))
+    assert state["qc.close_window_ms"] == 2.0
+    for _ in range(CALM_TICKS):
+        ctl.tick(_snap(qc={"pending": 0, "max_pending": 4096}))
+    assert state["qc.close_window_ms"] == 1.0
+    assert ctl._last_info["rule"] == "qc_idle"
+
+
+def test_spec_churn_shrinks_depth():
+    ctl, state = _controller()
+    ctl.tick(_snap(rollbacks=0))
+    ctl.tick(_snap(rollbacks=3))
+    assert state["spec.max_depth"] == 16
+    assert ctl._last_info["rule"] == "spec_churn"
+    # TN: no NEW rollbacks -> the cumulative counter no longer moves
+    # the knob
+    ctl.tick(_snap(rollbacks=3))
+    assert state["spec.max_depth"] == 16
+
+
+# ---------------------------------------------------------------------------
+# oscillation guard
+# ---------------------------------------------------------------------------
+
+
+def test_oscillation_guard_freezes_reversal(tmp_path):
+    """A direction reversal on the same knob within the oscillation
+    window freezes the knob (NOT applied), counts an oscillation, and
+    writes a ``guard`` ledger record."""
+    ctl, state = _controller(tmp_path, osc_window_ticks=10,
+                             freeze_ticks=5)
+    ctl.ledger.append("open", knobs=ctl.registry.values())
+    ctl.tick(_snap(shed=0))
+    ctl.tick(_snap(shed=1000, offered=600.0, accepted=100.0))  # cut
+    assert state["replica.shed_watermark"] == 32
+    ctl.tick(_snap(shed=1040, offered=80.0, accepted=80.0))  # reversal
+    assert state["replica.shed_watermark"] == 32  # frozen, not applied
+    assert ctl.oscillations == 1
+    ctl.tick(_snap(shed=1080, offered=80.0, accepted=80.0))
+    assert state["replica.shed_watermark"] == 32  # still frozen
+    run(ctl.stop())
+    recs, err = parse_decision_ledger(str(tmp_path / "t.knobs.jsonl"))
+    assert err == ""
+    kinds = [r["kind"] for r in recs]
+    assert "guard" in kinds
+    guard = next(r for r in recs if r["kind"] == "guard")
+    assert guard["knob"] == "replica.shed_watermark"
+
+
+# ---------------------------------------------------------------------------
+# knob registry bounds
+# ---------------------------------------------------------------------------
+
+
+def test_registry_rejects_off_ladder_values():
+    reg, state = _toy_registry()
+    with pytest.raises(ValueError):
+        reg.set("replica.shed_watermark", 77)
+    with pytest.raises(KeyError):
+        reg.set("no.such.knob", 1)
+    reg.set("replica.shed_watermark", 128)
+    assert state["replica.shed_watermark"] == 128
+
+
+def test_registry_steps_clamp_at_ladder_edges():
+    reg, state = _toy_registry()
+    reg.set("verify.max_batch", 512)
+    assert reg.peek_step("verify.max_batch", +1) == (512, 512)
+    reg.set("verify.max_batch", 64)
+    assert reg.peek_step("verify.max_batch", -1) == (64, 64)
+
+
+def test_committee_registry_caps_batch_at_warmed_ceiling():
+    """PBL006 by construction: the batch-shape ladders top out at the
+    constructor value — the controller can never request a shape that
+    was not warmed, so zero post-warm compiles is structural."""
+    com = LocalCommittee.build(n=4)
+    reg = registry_for_committee(com)
+    assert "replica.shed_watermark" in reg
+    wm0 = com.replicas[0].shed_watermark
+    assert max(reg.knob("replica.shed_watermark").choices) == wm0 * 4
+    if "verify.max_batch" in reg:
+        k = reg.knob("verify.max_batch")
+        assert max(k.choices) == com.replicas[0].verifier._max_batch
+    # setters fan out to every replica
+    lo = min(reg.knob("replica.shed_watermark").choices)
+    reg.set("replica.shed_watermark", lo)
+    assert all(r.shed_watermark == lo for r in com.replicas)
+    snap = reg.snapshot_block()
+    assert snap["knobs"]["replica.shed_watermark"]["value"] == lo
+
+
+# ---------------------------------------------------------------------------
+# decision ledger: chain, tamper, sim determinism, replay
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_chain_verifies_and_detects_tamper(tmp_path):
+    ctl, state = _controller(tmp_path)
+    ctl.ledger.append("open", knobs=ctl.registry.values())
+    ctl.tick(_snap(shed=0))
+    ctl.tick(_snap(shed=1000, offered=600.0, accepted=100.0))
+    run(ctl.stop())
+    path = tmp_path / "t.knobs.jsonl"
+    recs, err = parse_decision_ledger(str(path))
+    assert err == "" and len(recs) >= 3
+    assert recs[0]["prev"] == GENESIS
+    for r in recs:
+        assert chain_hash(r) == r["h"]
+    ok, rerr = replay_ledger(recs)
+    assert ok, rerr
+    # flip one recorded trigger signal: the chain must break
+    lines = path.read_text().splitlines()
+    doc = json.loads(lines[1])
+    doc["trigger"]["shed_delta"] = 0
+    lines[1] = json.dumps(doc, sort_keys=True)
+    path.write_text("\n".join(lines) + "\n")
+    _, err2 = parse_decision_ledger(str(path))
+    assert "chain break" in err2 or "hash" in err2
+
+
+def test_replay_rejects_unrefireable_action(tmp_path):
+    """Replay re-evaluates every action's rule over its recorded
+    trigger: an action whose trigger does not fire its rule is a
+    forged ledger, even with a valid hash chain."""
+    ctl, state = _controller(tmp_path)
+    led = ctl.ledger
+    led.append("open", knobs=ctl.registry.values())
+    led.append(
+        "action", tick=1, rule="storm_backlog", family="traffic",
+        knob="replica.shed_watermark", direction=-1, old=64, new=48,
+        trigger={"shed_delta": 0, "win_p99_ms": 10.0, "backlog": 0,
+                 "shed_watermark": 64},
+    )
+    led.close()
+    recs, err = parse_decision_ledger(str(tmp_path / "t.knobs.jsonl"))
+    assert err == ""
+    ok, rerr = replay_ledger(recs)
+    assert not ok and "re-fire" in rerr
+
+
+def test_sim_decision_ledger_is_seed_deterministic(tmp_path):
+    """Same seed, same scenario => byte-identical decision ledger (the
+    controller runs on the virtual clock; every signal it reads is a
+    pure function of the seed), and the ledger replays."""
+    def go(name):
+        sc = Scenario(
+            n=4, seed=5, horizon=4.0, workload={"preset": "steady"},
+            controller={"interval": 0.5},
+            flight_dir=str(tmp_path / name), name=name,
+        )
+        res = run_scenario(sc)
+        assert res.ok, res.failure
+        path = tmp_path / name / f"{name}.knobs.jsonl"
+        return path.read_bytes()
+
+    b1, b2 = go("a"), go("b")
+    assert b1 == b2
+    recs, err = parse_decision_ledger(
+        str(tmp_path / "a" / "a.knobs.jsonl"))
+    assert err == ""
+    ok, rerr = replay_ledger(recs)
+    assert ok, rerr
+    assert recs[0]["kind"] == "open" and recs[-1]["kind"] == "close"
+
+
+# ---------------------------------------------------------------------------
+# telemetry: knobs block is additive
+# ---------------------------------------------------------------------------
+
+
+def test_knobs_block_rides_snapshot_without_schema_bump():
+    com = LocalCommittee.build(n=4)
+    reg = com.attach_knobs()
+    snap = com.node_telemetry(com.replicas[0].id).snapshot()
+    assert snap["schema_version"] == SCHEMA_VERSION == 1
+    kb = snap["knobs"]
+    assert kb["schema"] == 1
+    assert "replica.shed_watermark" in kb["knobs"]
+    k = kb["knobs"]["replica.shed_watermark"]
+    assert k["lo"] <= k["value"] <= k["hi"]
+    # no registry attached -> no knobs key at all (additive surface)
+    com2 = LocalCommittee.build(n=4)
+    snap2 = com2.node_telemetry(com2.replicas[0].id).snapshot()
+    assert "knobs" not in snap2
+    assert reg.values()  # silence unused warning
+
+
+# ---------------------------------------------------------------------------
+# speculation depth gate
+# ---------------------------------------------------------------------------
+
+
+def test_spec_depth_gate_skips_when_full():
+    com = LocalCommittee.build(n=4)
+    r = com.replicas[0]
+    eng = r.spec
+    assert eng.max_depth == 64  # constructor default
+    eng.max_depth = 2
+    eng.slots[101] = object()
+    eng.slots[102] = object()
+
+    class _Inst:
+        seq = 103
+        block = [{"op": "x"}]
+        digest = "d"
+
+    before = r.metrics["spec_skipped_depth"]
+    assert eng.on_prepared(_Inst()) is None
+    assert r.metrics["spec_skipped_depth"] == before + 1
+    assert 103 not in eng.slots
+    assert eng.snapshot()["max_depth"] == 2
+
+
+# ---------------------------------------------------------------------------
+# bench_gate controller.* rows + pathological canary
+# ---------------------------------------------------------------------------
+
+
+def _ctl_bench_line(**over):
+    base = {
+        "swing_e2e_p99_ms": 124, "swing_p99_ms": 124.8,
+        "accepted": 1048, "offered": 4680, "actions": 5,
+        "oscillations": 0, "post_warm_compiles": 0,
+        "swing_p99_vs_best_fixed": 0.043,
+        "accepted_vs_best_fixed": 1.79,
+    }
+    base.update(over)
+    return {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "cell": "knob_campaign_ctl",
+        "controller": base,
+    }
+
+
+def _ctl_reference():
+    ref = _ctl_bench_line()
+    ref["gate"] = {
+        "max": {"controller.swing_p99_vs_best_fixed": 1.0,
+                "controller.oscillations": 4,
+                "controller.post_warm_compiles": 0},
+        "min": {"controller.accepted_vs_best_fixed": 1.0,
+                "controller.actions": 2},
+    }
+    ref["gate_mode"] = "floors"
+    return ref
+
+
+def test_bench_gate_passes_healthy_controller_cell():
+    from tools.bench_gate import run_gate
+
+    rep = run_gate([_ctl_bench_line()], [_ctl_reference()])
+    assert rep["ok"], rep
+
+
+def test_bench_gate_canary_catches_pathological_knobs():
+    """Negative test: a controller run that lost to the fixed sweep,
+    oscillated, or recompiled post-warm MUST flag — a gate that cannot
+    fail is not a gate."""
+    from tools.bench_gate import run_gate
+
+    for bad, metric in (
+        ({"swing_p99_vs_best_fixed": 1.6},
+         "controller.swing_p99_vs_best_fixed"),
+        ({"accepted_vs_best_fixed": 0.4},
+         "controller.accepted_vs_best_fixed"),
+        ({"oscillations": 9}, "controller.oscillations"),
+        ({"post_warm_compiles": 2}, "controller.post_warm_compiles"),
+        ({"actions": 0}, "controller.actions"),
+    ):
+        rep = run_gate([_ctl_bench_line(**bad)], [_ctl_reference()])
+        assert not rep["ok"]
+        assert any(r["metric"] == metric for r in rep["regressions"]), rep
+
+
+def test_rules_catalog_is_replay_complete():
+    """Every rule the controller can act on is resolvable by name for
+    replay, and its trigger keys are exactly its ``needs`` — the
+    ledger alone must reconstruct any decision."""
+    assert set(RULES_BY_NAME) == {r.name for r in RULES}
+    for r in RULES:
+        view = {k: 1.0 for k in r.needs}
+        trig = r.trigger(view)
+        assert set(trig) == set(r.needs)

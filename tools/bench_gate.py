@@ -98,6 +98,20 @@ METRICS: Dict[str, Tuple[int, float]] = {
     "traffic.bulk_p99_ms": (-1, 0.50),
     "traffic.shed_fraction": (-1, 0.25),
     "traffic.interactive_accept_ratio": (+1, 0.25),
+    # self-driving perf plane (ISSUE 19): knob-campaign swing cells.
+    # Virtual-time, so the latency floors guard control-law changes,
+    # not host noise. The dominance ratios are the contract: the
+    # controller's e2e p99 must stay below every fixed cell
+    # (swing_p99_vs_best_fixed < 1) while accepting at least as much as
+    # the best-latency fixed cell (accepted_vs_best_fixed >= 1). CI
+    # pins these via gate.min/gate.max floors
+    # (bench_results/controller_ci_reference.jsonl) because absolute
+    # accepted counts shift legitimately when shed defaults move.
+    "controller.swing_e2e_p99_ms": (-1, 0.50),
+    "controller.swing_p99_ms": (-1, 0.50),
+    "controller.swing_p99_vs_best_fixed": (-1, 0.50),
+    "controller.accepted_vs_best_fixed": (+1, 0.25),
+    "controller.actions": (+1, 0.50),
 }
 
 MAD_Z = 4.0  # tolerance = MAD_Z sigma-equivalents of the reference spread

@@ -36,7 +36,8 @@ from typing import Dict, List, Optional, Tuple
 COLUMNS = (
     "NODE", "SRC", "VIEW", "ROLE", "EXEC", "STABLE", "CAGE", "BACKLOG",
     "VQ", "QCQ", "QCB", "PAIRms", "SHED", "DEG", "QUAR", "REJ", "WDOG",
-    "AUD", "SPEC", "LOAD", "NET", "NETIO", "DEV", "RTTms", "LAGms", "REQ/s",
+    "AUD", "SPEC", "LOAD", "CTL", "NET", "NETIO", "DEV", "RTTms", "LAGms",
+    "REQ/s",
 )
 
 
@@ -136,6 +137,37 @@ def load_cell(snap: dict, prev: Optional[dict], dt: float) -> str:
         f"{_fmt_rate(d_off)}>{_fmt_rate(d_acc)}/s "
         f"{shed_pct:.0f}% {tr.get('worst_p99_ms', 0.0):.0f}ms"
     )
+
+
+def ctl_cell(snap: dict) -> str:
+    """CTL: self-driving perf-plane posture (ISSUE 19) —
+    ``profile last-rule(knob-shorthand) age`` plus ``FRZ:n`` when the
+    oscillation guard has knobs frozen and ``osc:n`` once any reversal
+    was counted. Works identically from a live scrape and from a
+    flight-file tail (the knobs block rides every frame). Blank when
+    the node carries no knob registry; a registry without a running
+    controller shows just the knob count (``8 knobs``) — knobs are
+    live-settable even when nothing is driving them. A big last-action
+    age during a storm means the controller is NOT reacting — check
+    the decision ledger's guard records before blaming the rules
+    (docs/OBSERVABILITY.md §self-driving perf plane)."""
+    kb = snap.get("knobs") or {}
+    if not kb:
+        return ""
+    post = kb.get("controller") or {}
+    if not post:
+        return f"{len(kb.get('knobs') or {})} knobs"
+    cell = str(post.get("profile", "?"))
+    last = post.get("last") or {}
+    if last:
+        knob = str(last.get("knob", "?")).split(".")[-1]
+        cell += f" {last.get('rule', '?')}({knob}) {post.get('last_age_s', 0):.0f}s"
+    frozen = (post.get("guard") or {}).get("frozen") or {}
+    if frozen:
+        cell += f" FRZ:{len(frozen)}"
+    if post.get("oscillations"):
+        cell += f" osc:{post['oscillations']}"
+    return cell
 
 
 def net_cell(snap: dict) -> str:
@@ -318,6 +350,7 @@ def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
         aud_cell,
         spec_cell(snap),
         load_cell(snap, prev, dt),
+        ctl_cell(snap),
         net_cell(snap),
         netio_cell(snap, prev, dt),
         dev_cell(snap),
