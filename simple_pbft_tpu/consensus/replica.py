@@ -35,7 +35,7 @@ import threading
 from collections import OrderedDict, defaultdict
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from .. import clock, spans
+from .. import clock, spans, trace
 from ..app import Application, KVStore
 from ..config import (
     CommitteeConfig,
@@ -213,6 +213,12 @@ class Replica:
         # message stream plus local commit/checkpoint events and appends
         # tamper-evident evidence records on equivocation/fork/divergence
         self.auditor = None
+        # per-certificate vote-arrival order statistics (trace plane):
+        # arrival rank of every vote at decode time, (2f+1)-th-vs-slowest
+        # margin, straggler id. Always attached (all methods never-raise
+        # and O(1)); emits quorum ledger docs only when a span sink is
+        # configured, surfaces live margins via telemetry's quorum block
+        self.qstats = trace.QuorumStats(node_id)
         self._replica_set = frozenset(cfg.replica_ids)
         self._running = False
         self._task: Optional[asyncio.Task] = None
@@ -560,9 +566,22 @@ class Replica:
         decoded: List[Message] = []
         for raw in sweep:
             try:
-                decoded.append(Message.from_wire(raw))
+                msg = Message.from_wire(raw)
             except ValueError:
                 self.metrics["malformed"] += 1
+                continue
+            decoded.append(msg)
+            # vote-arrival capture for the trace plane's quorum-margin
+            # statistics. Deliberately HERE — at decode, pre-verification
+            # and pre-shed — because post-quorum straggler votes are
+            # dropped by the _batch_items precheck and never reach
+            # _on_phase, yet their arrival time is exactly the straggler
+            # headroom being measured. Sender ids are unverified at this
+            # point; QuorumStats dedupes per sender and bounds its table.
+            if isinstance(msg, Prepare):
+                self.qstats.note_vote("prepare", msg.view, msg.seq, msg.sender)
+            elif isinstance(msg, Commit):
+                self.qstats.note_vote("commit", msg.view, msg.seq, msg.sender)
         decoded = self._shed_for_overload(decoded)
         self.stats.sweep_size.record(len(sweep))
         sig_spans: List[Tuple[int, int]] = []
@@ -1135,7 +1154,11 @@ class Replica:
             # our own proposal never transits _finish_sweep: log it so the
             # cross-node ledger holds the primary's own signed record too
             self.auditor.observe_message(pp)
-        await self.transport.broadcast(pp.to_wire(), self.cfg.replica_ids)
+        # trace envelope (unsigned, outside the signed fields — decode
+        # drops it before payload reconstruction) on the freshly signed
+        # wire frame; no-op unless the trace plane is enabled
+        pp_wire = trace.stamp(pp.to_wire(), trace.PREPREPARE, pp.view, seq, self.id)
+        await self.transport.broadcast(pp_wire, self.cfg.replica_ids)
         await self._on_phase(pp)  # self-delivery
 
     # ------------------------------------------------------------------
@@ -1308,7 +1331,14 @@ class Replica:
         self._qc_sent.add(key)
         self.signer.sign_msg(cert)
         self.metrics["qcs_formed"] += 1
-        await self.transport.broadcast(cert.to_wire(), self.cfg.replica_ids)
+        cert_wire = trace.stamp(
+            cert.to_wire(),
+            trace.QC_PREPARE if phase == "prepare" else trace.QC_COMMIT,
+            inst.view,
+            inst.seq,
+            self.id,
+        )
+        await self.transport.broadcast(cert_wire, self.cfg.replica_ids)
         await self._on_qc(cert)  # act on our own certificate
 
     async def _on_qc(self, msg: QuorumCert) -> None:
@@ -1395,6 +1425,14 @@ class Replica:
                     inst.t_prepared - inst.t_started,
                     node=self.id, view=act.view, seq=act.seq,
                 )
+            # the prepare certificate just formed here: freeze its quorum
+            # time so the arrival-order margin can finalize (QC-mode
+            # backups reach this via the cert, with no local vote log —
+            # QuorumStats counts those as partial, not a margin sample)
+            self.qstats.note_quorum(
+                "prepare", act.view, act.seq,
+                self.cfg.quorum, len(self.cfg.replica_ids),
+            )
             await self._send_vote(Commit, "commit", act)
             if self.spec is not None and inst is not None:
                 # the slot just PREPARED here: execute it speculatively
@@ -1425,6 +1463,10 @@ class Replica:
                         inst.t_committed - base,
                         node=self.id, view=act.view, seq=act.seq,
                     )
+            self.qstats.note_quorum(
+                "commit", act.view, act.seq,
+                self.cfg.quorum, len(self.cfg.replica_ids),
+            )
             self.ready[act.seq] = act
             # committee-liveness signal (failover deferral): an
             # ExecuteBlock action means a commit certificate formed for
@@ -1455,6 +1497,9 @@ class Replica:
             self.metrics["vote_suppressed_retired"] += 1
             return
         vote = cls(view=act.view, seq=act.seq, digest=act.digest)
+        # our own vote is self-delivered (_on_phase below) and never
+        # transits the transport recv seam, so its arrival is logged here
+        self.qstats.note_vote(phase, act.view, act.seq, self.id)
         if self.cfg.qc_mode:
             vote.bls_share = qc_mod.sign_share(
                 self.bls_sk, phase, act.view, act.seq, act.digest
@@ -1464,10 +1509,14 @@ class Replica:
             if primary == self.id:
                 await self._on_phase(vote)  # our own share, directly
             else:
-                await self.transport.send(primary, vote.to_wire())
+                wire = trace.stamp(
+                    vote.to_wire(), phase, act.view, act.seq, self.id
+                )
+                await self.transport.send(primary, wire)
             return
         self.signer.sign_msg(vote)
-        await self.transport.broadcast(vote.to_wire(), self.cfg.replica_ids)
+        wire = trace.stamp(vote.to_wire(), phase, act.view, act.seq, self.id)
+        await self.transport.broadcast(wire, self.cfg.replica_ids)
         await self._on_phase(vote)  # count own vote
 
     # ------------------------------------------------------------------
@@ -2642,6 +2691,9 @@ class Replica:
         if self.auditor is not None:
             # audit stores fold with the same watermark as everything else
             self.auditor.gc(seq)
+        # finalize trace-plane quorum stats for GC'd slots: a straggler
+        # vote that never arrives must not hold a cert record open forever
+        self.qstats.flush_upto(seq)
         # GC below the watermark: instances, checkpoint votes, committed
         # log, snapshots, and per-request dedup state. This is the log GC
         # the reference never had (CommittedMsgs grows forever, node.go:246).

@@ -37,7 +37,7 @@ import random
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
-from .. import clock
+from .. import clock, trace
 from ..crypto.verifier import BatchItem
 from ..messages import (
     EMPTY_BLOCK_DIGEST,
@@ -785,7 +785,11 @@ class ViewChanger:
         await self.r.ensure_checkpoint_qc()  # QC mode: one aggregate for h
         vc = self.build_view_change(new_view)
         self.r.signer.sign_msg(vc)
-        wire = vc.to_wire()
+        # trace envelope: view-change traffic carries no slot — seq=-1
+        # keeps the edge out of slot DAG joins but in the Perfetto view
+        wire = trace.stamp(
+            vc.to_wire(), trace.VIEWCHANGE, new_view, -1, self.r.id
+        )
         # Size guard: prepared proofs embed whole request blocks, so a full
         # window of full batches can exceed the certificate wire cap — the
         # message would be undeliverable exactly when a loaded primary
@@ -817,7 +821,10 @@ class ViewChanger:
         await self.r.ensure_checkpoint_qc()
         vc = self.build_view_change(self.target_view)
         self.r.signer.sign_msg(vc)
-        await self.r.transport.broadcast(vc.to_wire(), self.r.cfg.replica_ids)
+        wire = trace.stamp(
+            vc.to_wire(), trace.VIEWCHANGE, self.target_view, -1, self.r.id
+        )
+        await self.r.transport.broadcast(wire, self.r.cfg.replica_ids)
 
     def build_view_change(self, new_view: int) -> ViewChange:
         r = self.r
@@ -1004,7 +1011,9 @@ class ViewChanger:
         nv._validated = (vcs, [], [])
         self.new_view_sent.add(new_view)
         r.metrics["new_views_sent"] += 1
-        nv_wire = nv.to_wire()
+        nv_wire = trace.stamp(
+            nv.to_wire(), trace.NEWVIEW, new_view, -1, r.id
+        )
         r.metrics["max_newview_bytes"] = max(
             r.metrics.get("max_newview_bytes", 0), len(nv_wire)
         )

@@ -27,6 +27,10 @@ def main() -> None:
     ap.add_argument("--base-port", type=int, default=7000)
     ap.add_argument("--deploy-dir", default=None, help="reuse/keep a deployment dir")
     ap.add_argument("--keep", action="store_true", help="don't delete the deploy dir")
+    ap.add_argument("--trace", action="store_true",
+                    help="enable the cross-replica trace plane on every "
+                    "node (<deploy>/log/r*.spans.jsonl; join with "
+                    "tools/slot_trace.py)")
     args = ap.parse_args()
 
     from . import deploy
@@ -47,7 +51,7 @@ def main() -> None:
                         "--deploy-dir", deploy_dir,
                         "--verifier", args.verifier,
                         "--transport", args.transport,
-                    ],
+                    ] + (["--trace", "1"] if args.trace else []),
                     env=env,
                 )
             )

@@ -194,6 +194,13 @@ async def run_node(args) -> None:
         args.id,
         os.path.join(log_dir, f"{args.id}.spans.jsonl") if log_dir else None,
     )
+    # cross-replica trace plane (ISSUE 20): wire-envelope stamping is
+    # per-process global and off by default; edge/quorum docs share the
+    # span ledger, so a sink (log_dir) is required for them to persist
+    if getattr(args, "trace", 0) and log_dir:
+        from . import trace as trace_plane
+
+        trace_plane.configure(True)
     # device-plane observatory (ISSUE 14): reset the per-dispatch device
     # ledger HERE — after the verifier warm, so warmup compiles never
     # pollute the serving window's occupancy/rate aggregates, and in
@@ -385,6 +392,16 @@ def main() -> None:
         "debug mode; 0 = off. Sampling loss is counted in the "
         "snapshot's tracer.trace_dropped. Events go to "
         "<log-dir>/<id>.trace.jsonl",
+    )
+    ap.add_argument(
+        "--trace", type=int, default=0,
+        help="cross-replica trace plane (needs a log dir): stamp "
+        "unsigned trace envelopes on outbound consensus wires and "
+        "recv-stamp inbound ones into <log-dir>/<id>.spans.jsonl edge "
+        "docs, plus per-certificate quorum arrival-order records; join "
+        "all nodes' ledgers with tools/slot_trace.py (clock skew is "
+        "solved offline from the edges themselves); 0 disables "
+        "(docs/OBSERVABILITY.md)",
     )
     ap.add_argument(
         "--audit", type=int, default=1,

@@ -276,6 +276,14 @@ class Scenario:
     workload: Optional[Dict[str, Any]] = None
     slo: Dict[str, Any] = field(default_factory=dict)  # judge_slo overrides
     flight_dir: Optional[str] = None  # write per-replica flight frames here
+    # cross-replica trace plane (ISSUE 20): when set, wire stamping is
+    # enabled for the run and the process-wide span recorder writes its
+    # ledger (spans + cross-node edge docs + quorum docs) to
+    # <trace_dir>/sim.spans.jsonl. Virtual-clock timestamps make the
+    # joined ledger byte-deterministic across identical seeds. None =
+    # off: pre-ISSUE-20 scenarios replay with identical fingerprints
+    # (the envelope changes wire byte counts the SimTrace hashes).
+    trace_dir: Optional[str] = None
     # self-driving perf plane (ISSUE 19). ``knobs``: fixed settings
     # {knob name -> ladder value} applied through the KnobRegistry after
     # build (the campaign's fixed-knob cells). ``controller``: online
@@ -339,6 +347,7 @@ class Scenario:
             "slo": dict(self.slo),
             "knobs": dict(self.knobs),
             "controller": self.controller,
+            "trace_dir": self.trace_dir,
             "name": self.name,
         }
 
@@ -366,6 +375,7 @@ class Scenario:
             slo=dict(doc.get("slo", {})),
             knobs=dict(doc.get("knobs", {})),
             controller=doc.get("controller") or None,
+            trace_dir=doc.get("trace_dir") or None,
             name=str(doc.get("name", "")),
         )
 
@@ -488,6 +498,8 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
     from .consensus import replica as replica_mod
     from .consensus import speculation as speculation_mod
     from .consensus import statesync as statesync_mod
+    from . import spans as spans_mod
+    from . import trace as trace_plane
 
     t0_wall = time.monotonic()
     loop = asyncio.get_running_loop()
@@ -518,6 +530,16 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
         trace.note("net", s=src, d=dst, k=kind, n=nbytes, v=verdict)
 
     com.net.trace = _tap
+    if sc.trace_dir:
+        # cross-replica trace plane (ISSUE 20): stamp hot consensus wire
+        # frames and route the process-wide span recorder (phase spans +
+        # cross-node edge docs + per-cert quorum docs) into one joined
+        # ledger. Enabled BEFORE any traffic flows; restored in finally
+        # so back-to-back runs in one process stay independent (the
+        # configure() calls also reset the per-sender span counters that
+        # make two identical seeded runs byte-identical).
+        trace_plane.configure(True)
+        spans_mod.configure("sim", f"{sc.trace_dir}/sim.spans.jsonl")
     auditors: Dict[str, Any] = {}
     if sc.verify_signatures:
         # the audit plane taps the signature-VERIFIED stream; unsigned
@@ -657,6 +679,12 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
         flight_recorders = []
         if controller is not None:
             await controller.stop()  # seals the decision ledger
+        if sc.trace_dir:
+            # seal the quorum ledger: certs still open at shutdown (a
+            # straggler vote that never arrived) finalize with what was
+            # seen, while the span sink is still attached
+            for r in com.replicas:
+                r.qstats.flush_all()
         await com.stop()
     finally:
         statesync_mod.DEFECTS.clear()
@@ -677,6 +705,11 @@ async def _drive(sc: Scenario, trace: SimTrace) -> SimResult:
                 pass
         for a in auditors.values():
             a.close()
+        if sc.trace_dir:
+            # detach the process-wide surfaces as we found them so the
+            # next run_scenario in this process starts untraced
+            trace_plane.configure(False)
+            spans_mod.configure("", None)
         if registry is not None:
             # read the tuned values for details, then put process-global
             # knob targets (qc lane singleton) back as we found them so

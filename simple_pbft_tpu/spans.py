@@ -50,11 +50,10 @@ recent ring the autopsy exports.
 from __future__ import annotations
 
 import threading
-import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-from . import sanitize
+from . import clock, sanitize
 from .logutil import Histogram
 
 # canonical stage names (keep tools/critical_path.py's grouping in sync)
@@ -145,12 +144,15 @@ class SpanRecorder:
         persist: bool = True,
     ) -> None:
         """One span: ``dur`` seconds of ``stage``, ending now. The record
-        is stamped with its END time (monotonic) — start is end - dur,
-        same clock. ``persist=False`` marks per-message-volume stages:
-        histogram only — no file line, and no slot in the recent ring
-        (an autopsy's last-N window must hold the pipeline spans that
-        diagnose a wedge, not thousands of transport residencies)."""
-        end = time.monotonic()
+        is stamped with its END time on the clock seam (monotonic on real
+        runs, virtual under the sim loop — so sim span ledgers are
+        byte-deterministic and joinable with trace-plane edge docs) —
+        start is end - dur, same clock. ``persist=False`` marks
+        per-message-volume stages: histogram only — no file line, and no
+        slot in the recent ring (an autopsy's last-N window must hold the
+        pipeline spans that diagnose a wedge, not thousands of transport
+        residencies)."""
+        end = clock.now()
         rec = (stage, end, dur, node, view, seq, rid, n)
         with self._lock:
             h = self._hists.get(stage)
@@ -171,6 +173,28 @@ class SpanRecorder:
                     # degraded by ENOSPC must not keep inflating the
                     # on-disk count post-mortem tooling trusts
                     self.persisted += 1
+
+    def emit(self, doc: Dict[str, Any]) -> None:
+        """Write one non-span ledger doc straight to the JSONL sink.
+
+        The trace plane's cross-node edge events and per-certificate
+        quorum docs (trace.py) share the span ledger file — one
+        ``<id>.spans.jsonl`` per node is the unit slot_trace joins —
+        but they are not spans: no histogram, no ring slot, and no-op
+        when no sink is attached. Never raises (a ledger write must not
+        be able to take down the transport or consensus path calling
+        it)."""
+        try:
+            with self._lock:
+                sink = self._sink
+            if sink is None:
+                return
+            with self._sink_lock:
+                sink.write(doc)
+                if sink._fh is not None:
+                    self.persisted += 1
+        except Exception:
+            pass
 
     def _to_doc(self, rec) -> Dict[str, Any]:
         stage, end, dur, node, view, seq, rid, n = rec
@@ -237,6 +261,10 @@ def configure(node_id: str, path: Optional[str] = None) -> None:
 
 def record(stage: str, dur: float, **kw) -> None:
     _recorder.record(stage, dur, **kw)
+
+
+def emit(doc: Dict[str, Any]) -> None:
+    _recorder.emit(doc)
 
 
 def recent(limit: int = 256) -> List[Dict[str, Any]]:

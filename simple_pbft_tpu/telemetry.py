@@ -267,6 +267,15 @@ def replica_snapshot(replica) -> Dict[str, Any]:
             if getattr(replica, "spec", None) is not None
             else None
         ),
+        # trace-plane quorum block (ISSUE 20): per-certificate vote
+        # arrival-order statistics — live (2f+1)-th-vs-slowest margin
+        # histogram and the current straggler id. pbft_top's TRACE
+        # column reads this; None on replicas without QuorumStats
+        "quorum": (
+            replica.qstats.snapshot()
+            if getattr(replica, "qstats", None) is not None
+            else None
+        ),
     }
 
 

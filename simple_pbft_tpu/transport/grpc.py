@@ -30,6 +30,7 @@ from typing import AsyncIterator, Dict, Iterable, Optional, Tuple
 import grpc
 import grpc.aio
 
+from .. import trace
 from .base import WireAccounting, base_metrics
 from .tcp import MAX_FRAME, OUTBOX_DEPTH, RECV_BUFFER_BYTES
 
@@ -266,6 +267,8 @@ class GrpcTransport:
     async def recv(self) -> bytes:
         raw = await self._recv_q.get()
         self._recv_bytes -= len(raw)
+        # trace-plane recv stamp at the dequeue seam (see tcp.py)
+        trace.recv_stamp(self.node_id, raw)
         return raw
 
     def recv_nowait(self) -> Optional[bytes]:
@@ -274,4 +277,5 @@ class GrpcTransport:
         except asyncio.QueueEmpty:
             return None
         self._recv_bytes -= len(raw)
+        trace.recv_stamp(self.node_id, raw)
         return raw

@@ -15,7 +15,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, Optional, Set, Tuple
 
-from .. import spans
+from .. import spans, trace
 from .base import WireAccounting, base_metrics
 
 
@@ -167,6 +167,10 @@ class LocalEndpoint:
             node=self.node_id,
             persist=False,
         )
+        # recv-stamp AFTER queue residency so the trace edge's recv time
+        # includes injected fault delay and queue wait (never raises; a
+        # substring gate makes unstamped frames free)
+        trace.recv_stamp(self.node_id, raw)
         return raw
 
     def recv_nowait(self) -> Optional[bytes]:
@@ -181,4 +185,5 @@ class LocalEndpoint:
             node=self.node_id,
             persist=False,
         )
+        trace.recv_stamp(self.node_id, raw)
         return raw

@@ -24,6 +24,7 @@ import asyncio
 import logging
 from typing import Dict, Iterable, Optional, Tuple
 
+from .. import trace
 from ..messages import DEFERRABLE_KINDS
 from .base import WireAccounting, base_metrics
 
@@ -295,6 +296,10 @@ class TcpTransport:
     async def recv(self) -> bytes:
         raw = await self._recv_q.get()
         self._recv_bytes -= len(raw)
+        # trace-plane recv stamp at the dequeue seam: queue residency is
+        # part of the wire edge; self-sent frames are filtered by sender
+        # id inside (never raises, unstamped frames gated by substring)
+        trace.recv_stamp(self.node_id, raw)
         return raw
 
     def recv_nowait(self) -> Optional[bytes]:
@@ -303,4 +308,5 @@ class TcpTransport:
         except asyncio.QueueEmpty:
             return None
         self._recv_bytes -= len(raw)
+        trace.recv_stamp(self.node_id, raw)
         return raw

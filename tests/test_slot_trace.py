@@ -1,0 +1,432 @@
+"""Cross-replica trace plane (ISSUE 20): the unsigned wire envelope
+stamps without perturbing signatures or canonical frames, quorum-arrival
+order statistics attribute margins and stragglers, identical seeded sim
+runs emit byte-identical joined ledgers, the NTP-style skew solver
+recovers injected offsets exactly, slot_trace's distributed path
+reconciles against measured commit_ms within the 5% acceptance bound,
+the Perfetto export round-trips with paired async wire events, and the
+committed floors reference both passes an honest ledger and fails a
+doctored one (the canary contract)."""
+
+from __future__ import annotations
+
+import copy
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from simple_pbft_tpu import clock, spans, trace  # noqa: E402
+from simple_pbft_tpu.messages import Message, PrePrepare, Prepare  # noqa: E402
+from simple_pbft_tpu.sim import Scenario, run_scenario  # noqa: E402
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+span_ledger = _load_tool("span_ledger")
+slot_trace = _load_tool("slot_trace")
+critical_path = _load_tool("critical_path")
+bench_gate = _load_tool("bench_gate")
+pbft_top = _load_tool("pbft_top")
+
+RECON_BOUND = 0.05  # ISSUE 20 acceptance: |path - measured| / measured
+
+
+# ---------------------------------------------------------------------------
+# wire envelope
+
+
+@pytest.fixture()
+def stamping():
+    trace.configure(True)
+    yield
+    trace.configure(False)
+
+
+class TestEnvelope:
+    def test_stamp_preserves_decoded_message(self, stamping):
+        """The envelope is unsigned metadata: a stamped frame must
+        decode to the exact same message fields as the unstamped one
+        (Message._build drops unknown top-level keys)."""
+        msg = Prepare(view=2, seq=7, digest="ab" * 32, sender="r3")
+        raw = msg.to_wire()
+        stamped = trace.stamp(raw, trace.PREPARE, 2, 7, "r3")
+        assert stamped != raw
+        assert trace._GATE in stamped
+        assert (Message.from_wire(stamped).to_dict()
+                == Message.from_wire(raw).to_dict())
+
+    def test_stamped_frame_stays_canonical(self, stamping):
+        """Splicing at the sorted key position keeps the frame valid
+        canonical JSON — re-encoding reproduces the exact bytes."""
+        pp = PrePrepare(view=0, seq=1, digest="cd" * 32, block=[],
+                        sender="r0")
+        stamped = trace.stamp(pp.to_wire(), trace.PREPREPARE, 0, 1, "r0")
+        canon = json.dumps(
+            json.loads(stamped), sort_keys=True, separators=(",", ":")
+        ).encode()
+        assert canon == stamped
+
+    def test_extract_fields_and_span_counter(self, stamping):
+        msg = Prepare(view=4, seq=9, digest="ee" * 32, sender="r5")
+        stamped = trace.stamp(msg.to_wire(), trace.PREPARE, 4, 9, "r5")
+        env = trace.extract(stamped)
+        assert env is not None
+        assert env["p"] == "prepare" and env["v"] == 4 and env["q"] == 9
+        assert env["s"] == "r5" and isinstance(env["t"], int)
+        # configure() reset the per-sender counter: first stamp is span 0
+        assert env["i"] == 0
+        again = trace.stamp(msg.to_wire(), trace.PREPARE, 4, 9, "r5")
+        assert trace.extract(again)["i"] == 1
+
+    def test_stamp_idempotent(self, stamping):
+        msg = Prepare(view=1, seq=2, digest="aa" * 32, sender="r1")
+        stamped = trace.stamp(msg.to_wire(), trace.PREPARE, 1, 2, "r1")
+        assert trace.stamp(stamped, trace.PREPARE, 1, 2, "r1") == stamped
+
+    def test_disabled_is_byte_noop(self):
+        trace.configure(False)
+        raw = Prepare(view=1, seq=2, digest="aa" * 32).to_wire()
+        assert trace.stamp(raw, trace.PREPARE, 1, 2, "r1") is raw
+        assert trace.extract(raw) is None
+
+    def test_recv_stamp_emits_complete_edge_doc(self, stamping, tmp_path):
+        ledger_path = tmp_path / "r9.spans.jsonl"
+        spans.configure("r9", str(ledger_path))
+        try:
+            msg = Prepare(view=3, seq=11, digest="bb" * 32, sender="r3")
+            stamped = trace.stamp(msg.to_wire(), trace.PREPARE, 3, 11, "r3")
+            trace.recv_stamp("r9", stamped)       # cross-node: one edge
+            trace.recv_stamp("r3", stamped)       # self-delivery: skipped
+            trace.recv_stamp("r9", msg.to_wire())  # unstamped: no-op
+        finally:
+            spans.configure("", None)
+        docs = [json.loads(ln) for ln in
+                ledger_path.read_text().splitlines() if ln.strip()]
+        edges = [d for d in docs if d.get("evt") == "edge"]
+        assert len(edges) == 1
+        e = edges[0]
+        assert e["src"] == "r3" and e["node"] == "r9"
+        assert e["phase"] == "prepare" and e["view"] == 3 and e["seq"] == 11
+        assert isinstance(e["t_send_us"], int)
+        assert isinstance(e["t_recv_us"], int)
+
+
+# ---------------------------------------------------------------------------
+# quorum-arrival order statistics
+
+
+class TestQuorumStats:
+    @pytest.fixture()
+    def vclock(self, monkeypatch):
+        t = {"v": 0.0}
+        monkeypatch.setattr(clock, "now", lambda: t["v"])
+        return t
+
+    def test_margin_straggler_and_arrival_order(self, vclock, tmp_path):
+        ledger_path = tmp_path / "r0.spans.jsonl"
+        spans.configure("r0", str(ledger_path))
+        try:
+            qs = trace.QuorumStats("r0")
+            for t_s, sender in ((0.001, "r1"), (0.002, "r2"), (0.005, "r3")):
+                vclock["v"] = t_s
+                qs.note_vote("prepare", 0, 1, sender)
+            qs.note_quorum("prepare", 0, 1, quorum=3, n=4)
+            vclock["v"] = 0.009
+            qs.note_vote("prepare", 0, 1, "r0")   # straggler: all n seen
+        finally:
+            spans.configure("", None)
+        snap = qs.snapshot()
+        assert snap["certs"] == 1 and snap["open"] == 0
+        # margin = slowest - (2f+1)-th = 9ms - 5ms
+        assert snap["last_margin_ms"] == pytest.approx(4.0)
+        assert snap["last_straggler"] == "r0"
+        doc = [json.loads(ln) for ln in
+               ledger_path.read_text().splitlines()
+               if '"quorum"' in ln][0]
+        assert doc["order"] == ["r1", "r2", "r3", "r0"]
+        assert doc["votes"] == 4 and doc["quorum"] == 3
+
+    def test_duplicate_votes_first_arrival_wins(self, vclock):
+        qs = trace.QuorumStats("r0")
+        vclock["v"] = 0.001
+        qs.note_vote("commit", 0, 2, "r1")
+        vclock["v"] = 0.009
+        qs.note_vote("commit", 0, 2, "r1")   # retransmit: ignored
+        vclock["v"] = 0.002
+        qs.note_vote("commit", 0, 2, "r2")
+        vclock["v"] = 0.003
+        qs.note_vote("commit", 0, 2, "r3")
+        qs.note_quorum("commit", 0, 2, quorum=3, n=3)
+        snap = qs.snapshot()
+        assert snap["certs"] == 1
+        assert snap["last_straggler"] == "r3"
+        assert snap["last_margin_ms"] == pytest.approx(0.0)
+
+    def test_partial_cert_never_reaching_quorum(self, vclock):
+        """A QC-mode backup sees no vote flood: flush must count the
+        cert as partial, emit no margin, and never raise."""
+        qs = trace.QuorumStats("r1")
+        vclock["v"] = 0.001
+        qs.note_vote("prepare", 0, 3, "r2")
+        qs.flush_all()
+        snap = qs.snapshot()
+        assert snap["certs"] == 0 and snap["partial"] == 1
+        assert snap["open"] == 0
+
+    def test_flush_upto_watermark(self, vclock):
+        qs = trace.QuorumStats("r0")
+        for seq in (1, 2, 5):
+            vclock["v"] = 0.001 * seq
+            qs.note_vote("prepare", 0, seq, "r1")
+        qs.flush_upto(2)
+        assert len(qs._open) == 1   # seq 5 survives the watermark
+
+
+# ---------------------------------------------------------------------------
+# clock-skew solver
+
+
+def _edge(src, dst, t_true_us, lat_us, theta):
+    """One synthetic edge: per-node clocks read true time + theta."""
+    return {
+        "evt": "edge", "phase": "prepare", "view": 0, "seq": 1,
+        "src": src, "node": dst,
+        "t_send_us": t_true_us + theta[src],
+        "t_recv_us": t_true_us + lat_us + theta[dst],
+    }
+
+
+class TestSkewSolver:
+    def test_recovers_injected_offsets_exactly(self):
+        """Nodes with known clock offsets and a symmetric 1000us floor
+        latency: the solver must return the exact corrections that land
+        every timestamp on the reference node's clock."""
+        theta = {"a": 0.0, "b": 5000.0, "c": -3000.0}
+        edges = []
+        t = 0.0
+        for src, dst in (("a", "b"), ("b", "a"), ("b", "c"), ("c", "b")):
+            # one floor-latency frame per direction plus jittered ones
+            for jitter in (0.0, 740.0, 260.0):
+                edges.append(_edge(src, dst, t, 1000.0 + jitter, theta))
+                t += 10_000.0
+        sk = slot_trace.solve_offsets(edges)
+        assert sk["reference"] == "a"
+        assert sk["offset_us"] == {"a": 0.0, "b": -5000.0, "c": 3000.0}
+        assert sk["unanchored"] == []
+        assert sk["pairs"]["a<->b"]["rtt_min_us"] == pytest.approx(2000.0)
+        # corrected one-way latency is the true floor again
+        e = edges[0]
+        corrected = ((e["t_recv_us"] + sk["offset_us"]["b"])
+                     - (e["t_send_us"] + sk["offset_us"]["a"]))
+        assert corrected == pytest.approx(1000.0)
+
+    def test_one_way_traffic_stays_unanchored(self):
+        """Without return traffic latency and offset cannot be split —
+        the solver must report the pair unanchored, not guess."""
+        theta = {"a": 0.0, "b": 5000.0}
+        edges = [_edge("a", "b", 0.0, 1000.0, theta)]
+        sk = slot_trace.solve_offsets(edges)
+        assert set(sk["unanchored"]) == {"a", "b"}
+        assert sk["pairs"] == {}
+
+
+# ---------------------------------------------------------------------------
+# end-to-end on the sim (virtual clock, signatures off => deterministic)
+
+
+@pytest.fixture(scope="module")
+def traced_runs(tmp_path_factory):
+    dirs = []
+    for tag in ("a", "b"):
+        d = str(tmp_path_factory.mktemp(f"trace_{tag}"))
+        sc = Scenario(seed=5, n=4, clients=2, requests=10,
+                      spec="shape=wan3dc", verify_signatures=False,
+                      trace_dir=d)
+        res = run_scenario(sc, wall_timeout=120.0)
+        assert res.ok, res.failure
+        dirs.append(d)
+    return dirs
+
+
+@pytest.fixture(scope="module")
+def analysis(traced_runs):
+    ledger = span_ledger.load_ledger(span_ledger.discover(traced_runs[0]))
+    return ledger, slot_trace.analyze(ledger)
+
+
+class TestSimTracePlane:
+    def test_joined_trace_byte_deterministic(self, traced_runs):
+        """Two runs of the identical seeded scenario must write
+        byte-identical span ledgers: every persisted doc rides the
+        virtual clock and per-sender span counters reset per run."""
+        a, b = (open(os.path.join(d, "sim.spans.jsonl"), "rb").read()
+                for d in traced_runs)
+        assert a and a == b
+
+    def test_virtual_clock_offsets_solve_to_zero(self, analysis):
+        _, an = analysis
+        assert an["skew"]["unanchored"] == []
+        assert all(v == 0.0 for v in an["skew"]["offset_us"].values())
+        assert len(an["skew"]["pairs"]) > 0
+
+    def test_reconciliation_within_acceptance_bound(self, analysis):
+        _, an = analysis
+        rec = an["reconciliation"]
+        assert rec["slots"] > 0
+        assert rec["err_p50"] <= RECON_BOUND
+        assert rec["err_p99"] <= RECON_BOUND
+
+    def test_decomposition_names_dominant_edge(self, analysis):
+        _, an = analysis
+        assert an["slots"] > 0 and an["edges"] > 0
+        for d in an["decomposition"]:
+            assert d["dominant"] in slot_trace.SEGMENTS
+            assert sum(d["shares"].values()) == pytest.approx(1.0, abs=0.02)
+            assert (d["wire_share"] + d["compute_share"]
+                    == pytest.approx(1.0, abs=1e-6))
+
+    def test_quorum_docs_well_formed(self, analysis):
+        ledger, an = analysis
+        assert an["quorum"]["certs"] > 0
+        assert 0.0 < an["quorum"]["straggler_share"] <= 1.0
+        for q in ledger["quorum"]:
+            assert len(q["order"]) == q["votes"] >= q["quorum"]
+            assert q["margin_ms"] >= 0.0
+            assert q["straggler"] == q["order"][-1]
+
+    def test_edges_causal_on_shared_clock(self, analysis):
+        ledger, _ = analysis
+        assert all(e["t_recv_us"] >= e["t_send_us"]
+                   for e in ledger["edge"])
+
+    def test_perfetto_export_roundtrip(self, analysis):
+        ledger, an = analysis
+        doc = json.loads(json.dumps(
+            slot_trace.perfetto_export(ledger, an["skew"]["offset_us"]),
+            sort_keys=True,
+        ))
+        events = doc["traceEvents"]
+        names = {e["args"]["name"] for e in events if e["ph"] == "M"}
+        assert names == set(an["nodes"])
+        for e in events:
+            if e["ph"] == "X":
+                assert isinstance(e["ts"], float)
+                assert e["dur"] >= 0.0
+        begins = {e["id"] for e in events if e["ph"] == "b"}
+        ends = {e["id"] for e in events if e["ph"] == "e"}
+        assert begins and begins == ends
+
+
+# ---------------------------------------------------------------------------
+# shared loader + schema stamps (the ISSUE 20 small fix)
+
+
+class TestSharedLoader:
+    def test_both_tools_stamp_the_shared_schema_version(self, analysis):
+        ledger, an = analysis
+        cp = critical_path.analyze(ledger["span"])
+        assert (cp["schema_version"] == an["schema_version"]
+                == span_ledger.LEDGER_SCHEMA_VERSION)
+
+    def test_load_ledger_tolerates_torn_lines(self, tmp_path):
+        p = tmp_path / "x.spans.jsonl"
+        span = {"evt": "span", "stage": "phase.execute", "node": "r0",
+                "seq": 1, "view": 0, "dur_ms": 1.0, "t_mono": 2.0}
+        edge = {"evt": "edge", "phase": "prepare", "view": 0, "seq": 1,
+                "src": "r1", "node": "r0", "t_send_us": 1, "t_recv_us": 2}
+        p.write_text(json.dumps(span) + "\n"
+                     + '{"evt": "edge", "torn' + "\n"
+                     + json.dumps(edge) + "\n")
+        led = span_ledger.load_ledger([str(p)])
+        assert len(led["span"]) == 1 and len(led["edge"]) == 1
+        assert span_ledger.load_spans([str(p)]) == led["span"]
+
+
+# ---------------------------------------------------------------------------
+# bench_gate trace.* rows + the committed floors reference
+
+
+def _reference_lines():
+    path = os.path.join(ROOT, "bench_results", "trace_ci_reference.jsonl")
+    with open(path) as fh:
+        return [json.loads(ln) for ln in fh if ln.strip()]
+
+
+class TestBenchGateTraceRows:
+    def test_trace_metrics_registered(self):
+        for metric in ("trace.quorum_margin_p50_ms", "trace.straggler_share",
+                       "trace.reconciliation_err_p50",
+                       "trace.reconciliation_err_p99"):
+            assert metric in bench_gate.METRICS
+
+    def test_reference_passes_its_own_measurement(self):
+        ref = _reference_lines()
+        fresh = copy.deepcopy(ref)
+        for d in fresh:
+            d.pop("gate", None)
+            d.pop("gate_mode", None)
+        assert bench_gate.run_gate(fresh, ref)["ok"]
+
+    def test_doctored_line_canary_must_fail(self):
+        """The committed floors are real floors: push the reconciliation
+        error past gate.max and the gate MUST go red."""
+        ref = _reference_lines()
+        doctored = copy.deepcopy(ref)
+        for d in doctored:
+            d.pop("gate", None)
+            d.pop("gate_mode", None)
+        doctored[0]["trace"]["reconciliation_err_p50"] = 0.5
+        rep = bench_gate.run_gate(doctored, ref)
+        assert not rep["ok"]
+        assert any(r["metric"] == "trace.reconciliation_err_p50"
+                   for r in rep["regressions"])
+
+    def test_data_volume_floor_catches_empty_plane(self):
+        starved = copy.deepcopy(_reference_lines())
+        for d in starved:
+            d.pop("gate", None)
+            d.pop("gate_mode", None)
+        starved[0]["trace"]["certs"] = 10
+        assert not bench_gate.run_gate(starved, _reference_lines())["ok"]
+
+    def test_bench_line_shape(self, analysis):
+        _, an = analysis
+        line = slot_trace.bench_line(an, "cellname")
+        assert line["cell"] == "cellname"
+        for k in ("quorum_margin_p50_ms", "quorum_margin_p99_ms",
+                  "straggler_share", "reconciliation_err_p50",
+                  "reconciliation_err_p99", "certs", "slots"):
+            assert k in line["trace"]
+
+
+# ---------------------------------------------------------------------------
+# pbft_top TRACE column
+
+
+class TestTopColumn:
+    def test_trace_cell_formats_margin_and_straggler(self):
+        snap = {"replica": {"quorum": {
+            "certs": 3, "margin_ms": {"p50": 3.246}, "last_straggler": "r7",
+        }}}
+        assert pbft_top.trace_cell(snap) == "3.2!r7"
+
+    def test_trace_cell_blank_before_first_cert(self):
+        assert pbft_top.trace_cell({}) == ""
+        assert pbft_top.trace_cell(
+            {"replica": {"quorum": {"certs": 0}}}) == ""
+
+    def test_trace_column_registered(self):
+        assert "TRACE" in pbft_top.COLUMNS

@@ -112,6 +112,21 @@ METRICS: Dict[str, Tuple[int, float]] = {
     "controller.swing_p99_vs_best_fixed": (-1, 0.50),
     "controller.accepted_vs_best_fixed": (+1, 0.25),
     "controller.actions": (+1, 0.50),
+    # cross-replica trace plane (ISSUE 20): slot_trace aggregates over
+    # the joined committee ledger. The quorum margin is the headroom
+    # before a straggler enters the quorum path — it regresses UP (a
+    # growing gap means a replica is falling off the quorum pace), as
+    # does the most-frequent-straggler share (one node consistently
+    # last). The reconciliation error is structural: the distributed
+    # path must keep agreeing with the replicas' own commit_ms, so any
+    # rise means the join/skew-solve/edge-matching machinery broke, not
+    # the protocol. CI pins all of these with gate.max floors
+    # (bench_results/trace_ci_reference.jsonl) since sim runs are
+    # virtual-time deterministic.
+    "trace.quorum_margin_p50_ms": (-1, 0.50),
+    "trace.straggler_share": (-1, 0.25),
+    "trace.reconciliation_err_p50": (-1, 0.50),
+    "trace.reconciliation_err_p99": (-1, 0.50),
 }
 
 MAD_Z = 4.0  # tolerance = MAD_Z sigma-equivalents of the reference spread

@@ -32,51 +32,26 @@ Stdlib only; file format in docs/OBSERVABILITY.md.
 from __future__ import annotations
 
 import argparse
-import glob
 import json
 import os
 import sys
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
 
+# the ledger parse/discovery/percentile helpers are shared with
+# tools/slot_trace.py (ISSUE 20 small fix: one loader, two tools);
+# resolvable both as a script and as `import critical_path` from a
+# sibling tool (verify_observatory's idiom)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from span_ledger import (  # noqa: E402
+    LEDGER_SCHEMA_VERSION,
+    discover,
+    load_spans,
+    pctile as _pctile,
+)
+
 # keep in sync with simple_pbft_tpu/spans.py PHASE_STAGES
 PHASE_STAGES = ("phase.prepare", "phase.commit", "phase.execute")
-
-
-def load_spans(paths: List[str]) -> List[dict]:
-    """Every parseable span line across the given JSONL files (torn
-    final lines from a live or killed writer are skipped, like
-    pbft_top's flight tail)."""
-    out: List[dict] = []
-    for path in paths:
-        try:
-            with open(path) as fh:
-                for ln in fh:
-                    if not ln.strip():
-                        continue
-                    try:
-                        doc = json.loads(ln)
-                    except ValueError:
-                        continue
-                    if doc.get("evt") == "span" and "dur_ms" in doc:
-                        out.append(doc)
-        except OSError:
-            continue
-    return out
-
-
-def discover(log_dir: str) -> List[str]:
-    return sorted(
-        set(glob.glob(os.path.join(log_dir, "*.spans.jsonl")))
-        | set(glob.glob(os.path.join(log_dir, "spans.jsonl")))
-    )
-
-
-def _pctile(sorted_vals: List[float], p: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    i = min(len(sorted_vals) - 1, max(0, int(p / 100.0 * len(sorted_vals))))
-    return sorted_vals[i]
 
 
 def _stage_table(spans: List[dict]) -> Dict[str, Dict[str, float]]:
@@ -154,6 +129,7 @@ def _decompose(slots: List[dict], pcts: List[float]) -> List[dict]:
 def analyze(spans: List[dict], pcts: Optional[List[float]] = None) -> dict:
     slots = _slots(spans)
     return {
+        "schema_version": LEDGER_SCHEMA_VERSION,
         "spans": len(spans),
         "nodes": sorted({s.get("node") for s in spans if s.get("node")}),
         "stages": _stage_table(spans),

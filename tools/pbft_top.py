@@ -36,8 +36,8 @@ from typing import Dict, List, Optional, Tuple
 COLUMNS = (
     "NODE", "SRC", "VIEW", "ROLE", "EXEC", "STABLE", "CAGE", "BACKLOG",
     "VQ", "QCQ", "QCB", "PAIRms", "SHED", "DEG", "QUAR", "REJ", "WDOG",
-    "AUD", "SPEC", "LOAD", "CTL", "NET", "NETIO", "DEV", "RTTms", "LAGms",
-    "REQ/s",
+    "AUD", "SPEC", "LOAD", "CTL", "NET", "NETIO", "DEV", "TRACE", "RTTms",
+    "LAGms", "REQ/s",
 )
 
 
@@ -67,6 +67,23 @@ def netio_cell(snap: dict, prev: Optional[dict], dt: float) -> str:
 
 def _fmt_rate(v: float) -> str:
     return f"{v / 1000:.1f}k" if v >= 1000 else f"{v:.0f}"
+
+
+def trace_cell(snap: dict) -> str:
+    """TRACE: live quorum-margin view (ISSUE 20) — ``p50ms!straggler``
+    from the replica snapshot's quorum block: the p50 gap between the
+    (2f+1)-th and slowest vote arrival, and the node currently arriving
+    last ("3.2!r7" = 3.2 ms of straggler headroom, r7 trailing). Blank
+    until a certificate has finalized with a full arrival order (QC-mode
+    backups never see the vote flood — only the primary shows margins)."""
+    q = (snap.get("replica") or {}).get("quorum") or {}
+    if not q.get("certs"):
+        return ""
+    p50 = (q.get("margin_ms") or {}).get("p50", 0.0)
+    cell = f"{p50:.1f}"
+    if q.get("last_straggler"):
+        cell += f"!{q['last_straggler']}"
+    return cell
 
 
 def dev_cell(snap: dict) -> str:
@@ -354,6 +371,7 @@ def row_from_snapshot(snap: dict, src: str, prev: Optional[dict],
         net_cell(snap),
         netio_cell(snap, prev, dt),
         dev_cell(snap),
+        trace_cell(snap),
         (f"{ver['rtt_ms_ema']:.0f}" if "rtt_ms_ema" in ver else ""),
         (f"{lag['ema_ms']:.1f}" if "ema_ms" in lag else ""),
         rate,

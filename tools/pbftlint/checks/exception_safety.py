@@ -47,6 +47,10 @@ TELEMETRY_ROOTS = {
     "watchdog",
     "recorder",
     "devledger",
+    # cross-replica trace plane (ISSUE 20): wire-envelope stamping and
+    # per-certificate quorum-arrival stats called from replica/viewchange
+    "trace",
+    "qstats",
 }
 
 # (root, terminal attr) -> (owning module path, class or None, def name)
@@ -90,6 +94,23 @@ AUDITED_NO_RAISE: Dict[Tuple[str, str], Tuple[str, Optional[str], str]] = {
         "simple_pbft_tpu/devledger.py", None, "take_annotation"),
     ("devledger", "snapshot"): (
         "simple_pbft_tpu/devledger.py", None, "snapshot"),
+    # trace plane (ISSUE 20): stamp() returns the frame unchanged on any
+    # internal failure; QuorumStats methods broad-guard their own bodies
+    ("trace", "stamp"): ("simple_pbft_tpu/trace.py", None, "stamp"),
+    # the replica's one-time construction of its stats surface: plain
+    # attribute initialization, no I/O to fail
+    ("trace", "QuorumStats"): (
+        "simple_pbft_tpu/trace.py", "QuorumStats", "__init__"),
+    ("qstats", "note_vote"): (
+        "simple_pbft_tpu/trace.py", "QuorumStats", "note_vote"),
+    ("qstats", "note_quorum"): (
+        "simple_pbft_tpu/trace.py", "QuorumStats", "note_quorum"),
+    ("qstats", "flush_upto"): (
+        "simple_pbft_tpu/trace.py", "QuorumStats", "flush_upto"),
+    ("qstats", "flush_all"): (
+        "simple_pbft_tpu/trace.py", "QuorumStats", "flush_all"),
+    ("qstats", "snapshot"): (
+        "simple_pbft_tpu/trace.py", "QuorumStats", "snapshot"),
 }
 
 

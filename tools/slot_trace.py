@@ -1,0 +1,544 @@
+#!/usr/bin/env python3
+"""slot_trace: committee-global slot DAGs + distributed critical path.
+
+``critical_path.py`` decomposes a slot's latency at ONE node — the
+three ``phase.*`` spans tile admission -> execution, but every quorum
+wait inside them is opaque: it cannot say which message edge or which
+straggler replica the committee-global path runs through. This tool
+joins ALL nodes' span ledgers (the ``{"evt":"edge"}`` send/recv pairs
+recv-stamped by the transports plus the ``{"evt":"quorum"}`` vote
+arrival-order docs from the replicas — see simple_pbft_tpu/trace.py)
+into one causal DAG per slot and answers the distributed question:
+
+1. **Clock-skew solver** — real multi-process runs have independent
+   monotonic clocks (arbitrary per-process epochs). For every node
+   pair with traffic in both directions, the minimum observed one-way
+   delay ``d_ab = t_recv(b) - t_send(a)`` mixes true latency with the
+   clock offset; assuming the fastest frame each way saw symmetric
+   latency (the NTP argument), ``offset_b - offset_a =
+   (d_ab_min - d_ba_min) / 2`` and ``rtt_min = d_ab_min + d_ba_min``.
+   Offsets propagate from a reference node by BFS. Sim runs share one
+   virtual clock, so every solved offset comes out exactly 0 and the
+   joined trace is byte-deterministic across identical seeds.
+2. **Distributed critical path** — per executed slot (node, view,
+   seq), walk the commit backwards on corrected clocks: execution <-
+   commit certificate <- the commit vote edge that completed it <- the
+   voter's prepare quorum <- the prepare vote edge that completed THAT
+   <- that voter's admission compute <- the pre-prepare edge from the
+   primary. Message edges and compute spans alternate; per percentile
+   of measured slot latency the report names the dominant segment
+   ("at p99: 54% wire.prepare, 23% compute.admission, ...").
+3. **Reconciliation** — the path re-anchored at the node's own
+   pre-prepare arrival must agree with the replica's measured
+   ``commit_ms`` (the phase.* span sum): |path - measured| / measured
+   at p50/p99 is the structural error of the whole join (clock
+   solver + edge matching + span tiling). Same contract as
+   critical_path's intra-node tiling check, one level up.
+4. **Quorum margins** — per-certificate arrival-order stats: the
+   (2f+1)-th-vs-slowest margin and the straggler share of the most
+   frequent straggler (the Handel-overlay bet in PAPERS.md is exactly
+   that this order statistic dominates QC formation at large n).
+
+``--perfetto out.json`` exports Chrome-trace JSON: per-node tracks of
+phase spans plus async begin/end pairs for every wire edge — load in
+https://ui.perfetto.dev for visual flame inspection. ``--bench-ledger``
+emits one bench-ledger line (telemetry BENCH_SCHEMA_VERSION) carrying
+the ``trace.*`` metrics bench_gate gates on.
+
+Usage:
+  python tools/slot_trace.py --log-dir /tmp/trace
+  python tools/slot_trace.py --log-dir dep/log --json
+  python tools/slot_trace.py a.spans.jsonl b.spans.jsonl --perfetto t.json
+
+Stdlib only; wire-envelope format in docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from span_ledger import (  # noqa: E402
+    LEDGER_SCHEMA_VERSION,
+    discover,
+    load_ledger,
+    pctile,
+)
+
+# slack when matching "the edge that completed a quorum": a vote's recv
+# stamp lands at transport dequeue, the quorum forms after decode — the
+# same-sweep gap is microseconds, but corrected clocks add solver error
+EPS_US = 500.0
+
+# relative reconciliation needs a denominator above the timestamp
+# quantum: envelope stamps are whole microseconds, so a same-instant
+# catch-up slot (measured ~1 us) turns +-1 us of rounding into 100%
+# "error". Slots faster than 50x the quantum are excluded from the
+# relative statistic — their absolute disagreement is still bounded by
+# the quantum itself.
+RECON_MIN_US = 50.0
+
+WIRE_SEGMENTS = ("wire.preprepare", "wire.prepare", "wire.commit")
+COMPUTE_SEGMENTS = ("compute.admission", "compute.prepared", "compute.execute")
+SEGMENTS = (
+    "wire.preprepare",
+    "compute.admission",
+    "wire.prepare",
+    "compute.prepared",
+    "wire.commit",
+    "compute.execute",
+)
+
+
+# ---------------------------------------------------------------------------
+# clock-skew solver
+
+
+def solve_offsets(edges: List[dict]) -> Dict[str, Any]:
+    """Pairwise NTP-style offset solve from symmetric message pairs.
+
+    Returns {"reference": node, "offset_us": {node: correction},
+    "pairs": {"a<->b": {"rtt_min_us", "edges"}}, "unanchored": [...]}.
+    Corrections are ADDED to a node's local timestamps to land them on
+    the reference node's clock."""
+    dmin: Dict[Tuple[str, str], float] = {}
+    count: Dict[Tuple[str, str], int] = defaultdict(int)
+    nodes = set()
+    for e in edges:
+        a, b = e["src"], e["node"]
+        nodes.add(a)
+        nodes.add(b)
+        d = float(e["t_recv_us"]) - float(e["t_send_us"])
+        key = (a, b)
+        if key not in dmin or d < dmin[key]:
+            dmin[key] = d
+        count[key] += 1
+    # symmetric pairs only: one-way traffic cannot split latency from
+    # offset, so such neighbors stay unanchored (reported, not guessed)
+    adj: Dict[str, List[str]] = defaultdict(list)
+    for (a, b) in dmin:
+        if (b, a) in dmin:
+            adj[a].append(b)
+    offsets: Dict[str, float] = {}
+    ordered = sorted(nodes)
+    for root in ordered:
+        if root in offsets:
+            continue
+        if not adj.get(root) and len(ordered) > 1:
+            continue  # isolated until some component reaches it
+        offsets[root] = 0.0
+        queue = [root]
+        while queue:
+            a = queue.pop(0)
+            for b in sorted(adj.get(a, [])):
+                if b in offsets:
+                    continue
+                # corrected latencies equal both ways at the minimum:
+                # d_ab + c_b - c_a == d_ba + c_a - c_b
+                offsets[b] = offsets[a] + (dmin[(b, a)] - dmin[(a, b)]) / 2.0
+                queue.append(b)
+    pairs = {}
+    for (a, b), d in sorted(dmin.items()):
+        if a < b and (b, a) in dmin:
+            pairs[f"{a}<->{b}"] = {
+                "rtt_min_us": round(d + dmin[(b, a)], 1),
+                "edges": count[(a, b)] + count[(b, a)],
+            }
+    reference = ordered[0] if ordered else ""
+    return {
+        "reference": reference,
+        "offset_us": {n: round(offsets.get(n, 0.0), 1) for n in ordered},
+        "pairs": pairs,
+        "unanchored": [n for n in ordered if n not in offsets],
+    }
+
+
+# ---------------------------------------------------------------------------
+# slot DAG join + distributed critical path
+
+
+def _index(ledger: Dict[str, List[dict]], offsets: Dict[str, float]):
+    """Corrected-clock indexes for the path walk."""
+
+    def corr(node: str, t_us: float) -> float:
+        return t_us + offsets.get(node, 0.0)
+
+    # phase spans by (node, view, seq): end/start µs on corrected clocks
+    phase: Dict[Tuple, Dict[str, Tuple[float, float]]] = defaultdict(dict)
+    for s in ledger["span"]:
+        if s["stage"].startswith("phase.") and "seq" in s:
+            end = corr(s["node"], float(s["t_mono"]) * 1e6)
+            start = end - float(s["dur_ms"]) * 1e3
+            key = (s["node"], s.get("view"), s["seq"])
+            phase[key].setdefault(s["stage"], (start, end))
+    # edges by (phase-class, dst, view, seq): QC certs complete quorums
+    # on backups exactly like vote floods do on all-to-all committees
+    by_dst: Dict[Tuple, List[Tuple[float, float, str]]] = defaultdict(list)
+    for e in ledger["edge"]:
+        ph = e["phase"]
+        cls = {"qc-prepare": "prepare", "qc-commit": "commit"}.get(ph, ph)
+        if cls not in ("preprepare", "prepare", "commit"):
+            continue
+        t_send = corr(e["src"], float(e["t_send_us"]))
+        t_recv = corr(e["node"], float(e["t_recv_us"]))
+        by_dst[(cls, e["node"], e["view"], e["seq"])].append(
+            (t_recv, t_send, e["src"])
+        )
+    for lst in by_dst.values():
+        lst.sort()
+    return phase, by_dst
+
+
+def _completing(edges: List[Tuple[float, float, str]],
+                t_quorum: float) -> Optional[Tuple[float, float, str]]:
+    """The latest arrival at or before the quorum instant — the edge on
+    the critical path into that certificate."""
+    best = None
+    for t_recv, t_send, src in edges:
+        if t_recv <= t_quorum + EPS_US:
+            best = (t_recv, t_send, src)
+        else:
+            break
+    return best
+
+
+def walk_slots(ledger: Dict[str, List[dict]],
+               offsets: Dict[str, float]) -> List[dict]:
+    """One distributed-path record per executed (node, view, seq)."""
+    phase, by_dst = _index(ledger, offsets)
+    slots = []
+    for (node, view, seq), stages in phase.items():
+        if "phase.execute" not in stages:
+            continue  # still in flight at ledger close
+        exec_start, exec_end = stages["phase.execute"]
+        # measured intra-node latency: the phase.* span sum — identical
+        # to the replica's commit_ms sample (spans.py tiling contract)
+        measured = sum(e - s for s, e in stages.values())
+        segs: Dict[str, float] = {"compute.execute": exec_end - exec_start}
+        # commit quorum instant at this node = phase.commit end
+        t_commit = stages.get("phase.commit", (exec_start, exec_start))[1]
+        e_commit = _completing(
+            by_dst.get(("commit", node, view, seq), []), t_commit
+        )
+        voter = None
+        if e_commit is not None:
+            t_recv, t_send, voter = e_commit
+            segs["wire.commit"] = max(0.0, t_recv - t_send)
+            # the voter sent its commit the moment its prepare quorum
+            # formed; its compute segment runs from the prepare edge
+            # that completed THAT quorum (or its own admission) to send
+            v_stages = phase.get((voter, view, seq), {})
+            t_prep_v = v_stages.get("phase.prepare", (None, None))[1]
+            e_prep = _completing(
+                by_dst.get(("prepare", voter, view, seq), []),
+                t_prep_v if t_prep_v is not None else t_send,
+            )
+            if e_prep is not None:
+                pr_recv, pr_send, w = e_prep
+                segs["compute.prepared"] = max(0.0, t_send - pr_recv)
+                segs["wire.prepare"] = max(0.0, pr_recv - pr_send)
+                # W emitted its prepare right after admitting the
+                # pre-prepare: admission compute = pp arrival -> send
+                e_pp = by_dst.get(("preprepare", w, view, seq), [])
+                if e_pp:
+                    pp_recv, pp_send, _ = e_pp[0]
+                    segs["compute.admission"] = max(0.0, pr_send - pp_recv)
+                    segs["wire.preprepare"] = max(0.0, pp_recv - pp_send)
+            elif t_prep_v is not None:
+                # voter's quorum completed by its own vote (it was the
+                # last arrival): charge its whole prepare phase
+                v_start = v_stages["phase.prepare"][0]
+                segs["compute.prepared"] = max(0.0, t_send - v_start)
+        # reconciliation anchor: this node's own pre-prepare arrival
+        pp_here = by_dst.get(("preprepare", node, view, seq), [])
+        recon = None
+        if pp_here and measured >= RECON_MIN_US:
+            anchored = exec_end - pp_here[0][0]
+            recon = (anchored - measured) / measured
+        slots.append({
+            "node": node,
+            "view": view,
+            "seq": seq,
+            "measured_ms": round(measured / 1e3, 4),
+            "path_ms": round(sum(segs.values()) / 1e3, 4),
+            "segments_ms": {
+                k: round(v / 1e3, 4) for k, v in sorted(segs.items())
+            },
+            "via": voter,
+            "recon_err": None if recon is None else round(recon, 5),
+        })
+    slots.sort(key=lambda s: (s["measured_ms"], s["node"], s["seq"]))
+    return slots
+
+
+def _decompose(slots: List[dict], pcts: List[float]) -> List[dict]:
+    """Per percentile of measured slot latency: mean segment shares in
+    the band at (and just below) it, plus the dominant segment and the
+    wire-vs-compute split."""
+    out = []
+    n = len(slots)
+    if n == 0:
+        return out
+    band_w = max(1, n // 10)
+    for p in pcts:
+        i = min(n - 1, max(0, int(p / 100.0 * n)))
+        band = slots[max(0, i - band_w + 1): i + 1]
+        tot = sum(sum(s["segments_ms"].values()) for s in band) or 1e-9
+        shares = {}
+        for seg in SEGMENTS:
+            v = sum(s["segments_ms"].get(seg, 0.0) for s in band) / tot
+            if v > 0:
+                shares[seg] = round(v, 4)
+        dominant = max(shares, key=lambda k: shares[k]) if shares else ""
+        wire = round(
+            sum(v for k, v in shares.items() if k.startswith("wire.")), 4
+        )
+        out.append({
+            "pct": p,
+            "measured_ms": slots[i]["measured_ms"],
+            "band_slots": len(band),
+            "shares": shares,
+            "dominant": dominant,
+            "wire_share": wire,
+            "compute_share": round(1.0 - wire, 4),
+        })
+    return out
+
+
+def _quorum_stats(quorums: List[dict]) -> Dict[str, Any]:
+    margins = sorted(float(q["margin_ms"]) for q in quorums)
+    stragglers: Dict[str, int] = defaultdict(int)
+    for q in quorums:
+        stragglers[q["straggler"]] += 1
+    total = len(quorums)
+    top = sorted(stragglers.items(), key=lambda kv: (-kv[1], kv[0]))
+    return {
+        "certs": total,
+        "margin_p50_ms": round(pctile(margins, 50), 4),
+        "margin_p99_ms": round(pctile(margins, 99), 4),
+        "straggler_share": (
+            round(top[0][1] / total, 4) if total else 0.0
+        ),
+        "stragglers": {k: v for k, v in top[:5]},
+    }
+
+
+def analyze(ledger: Dict[str, List[dict]],
+            pcts: Optional[List[float]] = None) -> dict:
+    skew = solve_offsets(ledger["edge"])
+    slots = walk_slots(ledger, skew["offset_us"])
+    errs = sorted(
+        abs(s["recon_err"]) for s in slots if s["recon_err"] is not None
+    )
+    measured = [s["measured_ms"] for s in slots]
+    paths = sorted(s["path_ms"] for s in slots)
+    return {
+        "schema_version": LEDGER_SCHEMA_VERSION,
+        "nodes": sorted({s["node"] for s in ledger["span"]}
+                        | {e["node"] for e in ledger["edge"]}),
+        "edges": len(ledger["edge"]),
+        "slots": len(slots),
+        "skew": skew,
+        "slot_measured_ms": {
+            "p50": pctile(measured, 50),
+            "p99": pctile(measured, 99),
+        },
+        "slot_path_ms": {"p50": pctile(paths, 50), "p99": pctile(paths, 99)},
+        "decomposition": _decompose(slots, pcts or [50.0, 90.0, 99.0]),
+        "reconciliation": {
+            "slots": len(errs),
+            "err_p50": round(pctile(errs, 50), 5),
+            "err_p99": round(pctile(errs, 99), 5),
+        },
+        "quorum": _quorum_stats(ledger["quorum"]),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Perfetto / Chrome-trace export
+
+
+def perfetto_export(ledger: Dict[str, List[dict]],
+                    offsets: Dict[str, float]) -> dict:
+    """Chrome trace-event JSON: one numeric pid per node (named via
+    process_name metadata), complete "X" events for spans, async
+    "b"/"e" pairs for wire edges (async rather than flow events: flows
+    need an enclosing slice on both ends, which a wire edge's endpoints
+    don't guarantee)."""
+    nodes = sorted({s["node"] for s in ledger["span"]}
+                   | {e["node"] for e in ledger["edge"]}
+                   | {e["src"] for e in ledger["edge"]})
+    pid = {n: i + 1 for i, n in enumerate(nodes)}
+    events: List[dict] = []
+    for n in nodes:
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid[n], "tid": 0,
+            "args": {"name": n},
+        })
+    for s in ledger["span"]:
+        end = float(s["t_mono"]) * 1e6 + offsets.get(s["node"], 0.0)
+        dur = float(s["dur_ms"]) * 1e3
+        ev = {
+            "ph": "X", "cat": "span", "name": s["stage"],
+            "pid": pid[s["node"]], "tid": 1,
+            "ts": round(end - dur, 1), "dur": round(dur, 1),
+        }
+        args = {k: s[k] for k in ("view", "seq", "rid", "n") if k in s}
+        if args:
+            ev["args"] = args
+        events.append(ev)
+    for i, e in enumerate(ledger["edge"]):
+        name = f"wire.{e['phase']}"
+        args = {"view": e["view"], "seq": e["seq"],
+                "src": e["src"], "dst": e["node"]}
+        events.append({
+            "ph": "b", "cat": "wire", "id": i, "name": name,
+            "pid": pid[e["src"]], "tid": 2,
+            "ts": round(float(e["t_send_us"]) + offsets.get(e["src"], 0.0), 1),
+            "args": args,
+        })
+        events.append({
+            "ph": "e", "cat": "wire", "id": i, "name": name,
+            "pid": pid[e["node"]], "tid": 2,
+            "ts": round(float(e["t_recv_us"]) + offsets.get(e["node"], 0.0), 1),
+        })
+    for q in ledger["quorum"]:
+        events.append({
+            "ph": "i", "cat": "quorum", "s": "p",
+            "name": f"quorum.{q['phase']}",
+            "pid": pid.get(q["node"], 0), "tid": 3,
+            "ts": round(float(q["t_quorum_us"])
+                        + offsets.get(q["node"], 0.0), 1),
+            "args": {"seq": q["seq"], "margin_ms": q["margin_ms"],
+                     "straggler": q["straggler"]},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# bench-ledger emission (bench_gate's trace.* rows)
+
+
+def bench_line(an: dict, cell: str) -> dict:
+    """One bench-ledger line carrying the gated trace.* metrics.
+    schema_version here is the BENCH ledger's, imported lazily so the
+    tool stays stdlib-only for every other mode."""
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from simple_pbft_tpu.telemetry import BENCH_SCHEMA_VERSION
+
+    return {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "cell": cell,
+        "trace": {
+            "quorum_margin_p50_ms": an["quorum"]["margin_p50_ms"],
+            "quorum_margin_p99_ms": an["quorum"]["margin_p99_ms"],
+            "straggler_share": an["quorum"]["straggler_share"],
+            "reconciliation_err_p50": an["reconciliation"]["err_p50"],
+            "reconciliation_err_p99": an["reconciliation"]["err_p99"],
+            "certs": an["quorum"]["certs"],
+            "slots": an["slots"],
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+
+
+def render(an: dict) -> str:
+    sk = an["skew"]
+    lines = [
+        f"slot_trace: {len(an['nodes'])} nodes, {an['edges']} edges, "
+        f"{an['slots']} executed slots, {an['quorum']['certs']} certs"
+    ]
+    offs = [v for v in sk["offset_us"].values() if v]
+    lines.append(
+        f"-- clock solve: ref {sk['reference']}, "
+        f"{len(sk['pairs'])} symmetric pairs, "
+        f"max |offset| {max((abs(v) for v in offs), default=0.0):.1f} us"
+        + (f", unanchored: {','.join(sk['unanchored'])}"
+           if sk["unanchored"] else "")
+    )
+    lines.append("-- distributed path (per measured-latency pct):")
+    for d in an["decomposition"]:
+        shares = ", ".join(
+            f"{v * 100.0:.0f}% {k}" for k, v in sorted(
+                d["shares"].items(), key=lambda kv: -kv[1]
+            )
+        )
+        lines.append(
+            f"   p{d['pct']:<4.4g} {d['measured_ms']:9.2f} ms  "
+            f"wire {d['wire_share'] * 100.0:.0f}% | {shares}"
+        )
+    rec = an["reconciliation"]
+    lines.append(
+        f"-- reconciliation vs commit_ms: |err| p50 "
+        f"{rec['err_p50'] * 100.0:.2f}%  p99 {rec['err_p99'] * 100.0:.2f}% "
+        f"({rec['slots']} slots)"
+    )
+    q = an["quorum"]
+    lines.append(
+        f"-- quorum margins: p50 {q['margin_p50_ms']:.3f} ms  "
+        f"p99 {q['margin_p99_ms']:.3f} ms; straggler share "
+        f"{q['straggler_share'] * 100.0:.0f}% "
+        f"{dict(list(q['stragglers'].items())[:3])}"
+    )
+    return "\n".join(lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="committee-global slot DAGs + distributed critical path"
+    )
+    ap.add_argument("files", nargs="*", help="span-ledger JSONL files")
+    ap.add_argument("--log-dir", default=None,
+                    help="discover *.spans.jsonl (and spans.jsonl) here")
+    ap.add_argument("--pcts", default="50,90,99",
+                    help="comma-separated measured-latency percentiles")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the analysis as one JSON document")
+    ap.add_argument("--perfetto", default=None, metavar="OUT",
+                    help="write Chrome-trace JSON here (ui.perfetto.dev)")
+    ap.add_argument("--bench-ledger", default=None, metavar="OUT",
+                    help="append one bench-ledger line with trace.* metrics")
+    ap.add_argument("--cell", default="slot_trace",
+                    help="cell name for the --bench-ledger line")
+    args = ap.parse_args()
+
+    paths = list(args.files)
+    if args.log_dir:
+        paths.extend(discover(args.log_dir))
+    if not paths:
+        print("slot_trace: no span files (use --log-dir or name files)",
+              file=sys.stderr)
+        sys.exit(1)
+    ledger = load_ledger(paths)
+    if not ledger["edge"]:
+        print(f"slot_trace: no edge docs in {len(paths)} files — was the "
+              "run traced? (sim: Scenario.trace_dir; node.py: --trace)",
+              file=sys.stderr)
+        sys.exit(1)
+    pcts = [float(p) for p in args.pcts.split(",") if p.strip()]
+    an = analyze(ledger, pcts)
+    if args.perfetto:
+        doc = perfetto_export(ledger, an["skew"]["offset_us"])
+        with open(args.perfetto, "w") as fh:
+            json.dump(doc, fh, sort_keys=True)
+    if args.bench_ledger:
+        with open(args.bench_ledger, "a") as fh:
+            fh.write(json.dumps(bench_line(an, args.cell), sort_keys=True)
+                     + "\n")
+    if args.json:
+        print(json.dumps(an, sort_keys=True))
+    else:
+        print(render(an))
+
+
+if __name__ == "__main__":
+    main()

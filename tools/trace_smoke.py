@@ -1,0 +1,188 @@
+#!/usr/bin/env python3
+"""trace_smoke: CI gate for the cross-replica trace plane (ISSUE 20).
+
+One invocation proves the whole plane end to end, both directions:
+
+1. SMOKE — run the canonical traced WAN committee (n=16,
+   ``shape=wan3dc``, signatures off so every persisted span rides the
+   virtual clock and the joined ledger is byte-deterministic) and
+   require the run ok with wire edges, quorum certs, and executed
+   slots in the joined ledger.
+2. RECONCILE — tools/slot_trace.py's distributed path, re-anchored at
+   each node's own pre-prepare arrival, must agree with the replica's
+   measured ``commit_ms`` within ``--max-recon`` at p50 AND p99. This
+   is the acceptance bound on the whole join: clock-skew solve + edge
+   matching + span tiling, in one number.
+3. EXPORT — the Perfetto/Chrome-trace export must be loadable JSON
+   whose async wire-edge events pair up (every "b" has its "e").
+4. LEDGER — append a schema-pinned bench line (cell: ``trace_smoke``)
+   for tools/bench_gate.py's ``trace.*`` rows (floors-mode reference:
+   bench_results/trace_ci_reference.jsonl).
+5. CANARY — doctor the fresh line's reconciliation error past the
+   reference's ``gate.max`` and REQUIRE bench_gate to fail it. A
+   floor that cannot fail is not a floor (traffic_smoke's contract).
+
+Exit codes: 0 = all gates pass; 1 = a gate failed; 2 = structural
+(run crashed, no ledger, reference unreadable).
+
+Usage:
+  python tools/trace_smoke.py --out /tmp/trace_smoke
+  python tools/trace_smoke.py --out /tmp/ts --json --skip-canary
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import sys
+from typing import Any, Dict
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from simple_pbft_tpu.sim import Scenario, run_scenario  # noqa: E402
+from tools import bench_gate, slot_trace  # noqa: E402
+from tools.span_ledger import discover, load_ledger  # noqa: E402
+
+DEFAULT_REFERENCE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "bench_results", "trace_ci_reference.jsonl",
+)
+
+
+def canonical_scenario(trace_dir: str, seed: int = 7) -> Scenario:
+    """THE trace-plane CI scenario. The floors reference was generated
+    from this exact shape — change it and the reference must be
+    regenerated (same seed => byte-identical ledger => identical
+    metrics, so the floors hold with zero noise margin)."""
+    return Scenario(
+        seed=seed,
+        n=16,
+        clients=4,
+        requests=12,
+        spec="shape=wan3dc",
+        verify_signatures=False,
+        trace_dir=trace_dir,
+        name="trace_smoke_wan16",
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--out", default="trace_smoke_out",
+                    help="span ledger + perfetto + bench line land here")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--max-recon", type=float, default=0.05,
+                    help="reconciliation |err| bound at p50 and p99")
+    ap.add_argument("--wall-timeout", type=float, default=300.0)
+    ap.add_argument("--reference", default=DEFAULT_REFERENCE,
+                    help="floors reference ledger for the canary")
+    ap.add_argument("--skip-canary", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    trace_dir = os.path.join(args.out, "trace")
+    os.makedirs(trace_dir, exist_ok=True)
+    gates: Dict[str, Any] = {}
+
+    # 1. smoke ------------------------------------------------------------
+    sc = canonical_scenario(trace_dir, seed=args.seed)
+    res = run_scenario(sc, wall_timeout=args.wall_timeout)
+    paths = discover(trace_dir)
+    if not paths:
+        print("trace_smoke: run left no span ledger", file=sys.stderr)
+        sys.exit(2)
+    ledger = load_ledger(paths)
+    if not ledger["edge"]:
+        print("trace_smoke: ledger has no wire edges", file=sys.stderr)
+        sys.exit(2)
+    an = slot_trace.analyze(ledger)
+    gates["smoke"] = {
+        "run_ok": res.ok,
+        "failure": res.failure,
+        "committed": res.committed,
+        "edges": an["edges"],
+        "slots": an["slots"],
+        "certs": an["quorum"]["certs"],
+        "ok": res.ok and an["edges"] > 0 and an["slots"] > 0
+        and an["quorum"]["certs"] > 0,
+    }
+
+    # 2. reconcile --------------------------------------------------------
+    rec = an["reconciliation"]
+    gates["reconcile"] = {
+        "err_p50": rec["err_p50"],
+        "err_p99": rec["err_p99"],
+        "bound": args.max_recon,
+        "dominant_p99": next(
+            (d["dominant"] for d in an["decomposition"] if d["pct"] == 99.0),
+            "",
+        ),
+        "ok": (rec["slots"] > 0 and rec["err_p50"] <= args.max_recon
+               and rec["err_p99"] <= args.max_recon),
+    }
+
+    # 3. export -----------------------------------------------------------
+    perfetto_path = os.path.join(args.out, "trace.perfetto.json")
+    doc = slot_trace.perfetto_export(ledger, an["skew"]["offset_us"])
+    with open(perfetto_path, "w") as fh:
+        json.dump(doc, fh, sort_keys=True)
+    with open(perfetto_path) as fh:
+        loaded = json.load(fh)
+    begins = {e["id"] for e in loaded["traceEvents"] if e["ph"] == "b"}
+    ends = {e["id"] for e in loaded["traceEvents"] if e["ph"] == "e"}
+    gates["export"] = {
+        "events": len(loaded["traceEvents"]),
+        "wire_pairs": len(begins),
+        "ok": len(loaded["traceEvents"]) > 0 and begins == ends,
+    }
+
+    # 4. ledger -----------------------------------------------------------
+    line = slot_trace.bench_line(an, "trace_smoke")
+    bench_path = os.path.join(args.out, "trace_bench.jsonl")
+    with open(bench_path, "a") as fh:
+        fh.write(json.dumps(line, sort_keys=True) + "\n")
+    gates["ledger"] = {"path": bench_path, "ok": True}
+
+    # 5. canary -----------------------------------------------------------
+    if not args.skip_canary:
+        try:
+            with open(args.reference) as fh:
+                ref = [json.loads(ln) for ln in fh if ln.strip()]
+        except OSError as exc:
+            print(f"trace_smoke: reference unreadable: {exc}",
+                  file=sys.stderr)
+            sys.exit(2)
+        gate_max = next(
+            (d["gate"].get("max", {}) for d in ref
+             if isinstance(d.get("gate"), dict)), {},
+        )
+        lim = gate_max.get("trace.reconciliation_err_p50")
+        doctored = copy.deepcopy(line)
+        doctored["trace"]["reconciliation_err_p50"] = (
+            (float(lim) if lim is not None else 0.0) + 1.0
+        )
+        rep = bench_gate.run_gate([doctored], ref)
+        gates["canary"] = {
+            "doctored_err_p50": doctored["trace"]["reconciliation_err_p50"],
+            "gate_caught_it": not rep["ok"],
+            "ok": not rep["ok"],
+        }
+
+    ok = all(g["ok"] for g in gates.values())
+    if args.json:
+        print(json.dumps({"ok": ok, "gates": gates}, sort_keys=True))
+    else:
+        for name, g in gates.items():
+            print(f"{'PASS' if g['ok'] else 'FAIL'} {name}: "
+                  + ", ".join(f"{k}={v}" for k, v in g.items()
+                              if k != "ok"))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
